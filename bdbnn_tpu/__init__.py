@@ -1,0 +1,31 @@
+"""bdbnn_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework for training
+bimodal-distributed binarized neural networks (BD-BNN).
+
+Re-designed from scratch against the behavior of the BlueAnon/BD-BNN
+reference (PyTorch/CUDA/NCCL), with a TPU-first architecture:
+
+- binarization as ``jax.custom_vjp`` transforms (STE / ApproxSign / EDE)
+  instead of autograd-module mutation (reference ``train.py:409-415``),
+- pure jit-compiled train steps (losses fused by XLA) instead of
+  per-batch Python objects (reference ``train.py:461-484``),
+- ``jax.sharding.Mesh`` + XLA collectives over ICI/DCN instead of NCCL
+  DistributedDataParallel (reference ``train.py:237-314``),
+- grain/tf.data-style host-sharded input pipelines instead of
+  ``torch.utils.data.DataLoader`` (reference ``loader.py``).
+"""
+
+from bdbnn_tpu import configs, data, losses, models, nn, parallel, train, utils
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "configs",
+    "data",
+    "losses",
+    "models",
+    "nn",
+    "parallel",
+    "train",
+    "utils",
+    "__version__",
+]

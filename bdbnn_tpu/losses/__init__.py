@@ -1,0 +1,36 @@
+from bdbnn_tpu.losses import kd, kurtosis
+from bdbnn_tpu.losses.kd import (
+    distribution_loss,
+    layer_weight_kl,
+    layer_weight_kl_softened,
+    loss_kd,
+    match_conv_pairs,
+    softmax_cross_entropy,
+)
+
+# NB: the bare kurtosis() function is deliberately NOT re-exported here —
+# it would shadow the `bdbnn_tpu.losses.kurtosis` submodule attribute.
+# Use `kurtosis.kurtosis` or import it from the submodule directly.
+from bdbnn_tpu.losses.kurtosis import (
+    kurtosis_loss,
+    kurtosis_regularization,
+    l2_regularization,
+    resolve_targets,
+    weight_to_pm1_regularization,
+)
+
+__all__ = [
+    "kd",
+    "kurtosis",
+    "kurtosis_loss",
+    "kurtosis_regularization",
+    "l2_regularization",
+    "resolve_targets",
+    "weight_to_pm1_regularization",
+    "distribution_loss",
+    "layer_weight_kl",
+    "layer_weight_kl_softened",
+    "loss_kd",
+    "match_conv_pairs",
+    "softmax_cross_entropy",
+]

@@ -1,0 +1,133 @@
+"""Teacher–student knowledge-distillation losses.
+
+Functional equivalents of the reference's ``utils/KD_loss.py``. The
+reference rescans all teacher×student module pairs every batch
+(O(L²) ``named_modules`` loops, ``utils/KD_loss.py:59-66``); here pair
+matching happens once at init (:func:`match_conv_pairs`) and the losses
+are pure functions of weight lists, fused into the jitted step.
+
+Numerics parity (deliberate, see SURVEY.md Appendix B #11): the layer
+KL is torch's ``KLDivLoss(log_target=True)`` applied to **raw weights**
+with the default 'mean' (elementwise-mean) reduction — mathematically
+loose (weights are not log-probabilities) but it is the shipped
+behavior: loss = mean(exp(w_t) * (w_t - w_s)).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def softmax_cross_entropy(logits: Array, labels: Array) -> Array:
+    """Mean CE over the batch with integer labels (↔ nn.CrossEntropyLoss,
+    reference ``train.py:318``)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def distribution_loss(stud_logits: Array, teacher_logits: Array) -> Array:
+    """Logit distillation: batch-mean of −softmax(teacher)·log_softmax(stud)
+    (reference ``DistributionLoss``, ``utils/KD_loss.py:10-43``).
+
+    The teacher side is stop_gradient'ed, replacing the reference's
+    runtime ``requires_grad`` assertion (``utils/KD_loss.py:22-23``).
+    """
+    teacher_logits = jax.lax.stop_gradient(teacher_logits)
+    logp_s = jax.nn.log_softmax(stud_logits, axis=1)
+    p_t = jax.nn.softmax(teacher_logits, axis=1)
+    return jnp.mean(-jnp.sum(p_t * logp_s, axis=1))
+
+
+def _kl_div_log_target_mean(input_: Array, log_target: Array) -> Array:
+    """torch.nn.KLDivLoss(log_target=True, reduction='mean'):
+    elementwise mean of exp(target) * (target - input)."""
+    return jnp.mean(jnp.exp(log_target) * (log_target - input_))
+
+
+def layer_weight_kl(
+    stud_weights: Sequence[Array],
+    teacher_weights: Sequence[Array],
+) -> Array:
+    """Per-layer weight "KL" summed over matched conv pairs (reference
+    ``DistributionLoss_layer``, ``utils/KD_loss.py:46-67``): for each
+    pair, KLDivLoss(log_target=True) on the raw weight tensors, with
+    student as input and teacher as (log-)target."""
+    total = jnp.float32(0.0)
+    for ws, wt in zip(stud_weights, teacher_weights, strict=True):
+        wt = jax.lax.stop_gradient(wt)
+        total = total + _kl_div_log_target_mean(ws, wt)
+    return total
+
+
+def layer_weight_kl_softened(
+    stud_weights: Sequence[Array],
+    teacher_weights: Sequence[Array],
+    temperature: float = 6.0,
+) -> Array:
+    """Temperature-softened per-layer weight KL over axis 1 (reference
+    ``DistributionLoss_layer_cifar_act``, ``utils/KD_loss.py:69-87``):
+    Σ_pairs elementwise-mean KL(softmax(w_t/T, axis=1) ‖ softmax(w_s/T,
+    axis=1)) · T²."""
+    T = temperature
+    total = jnp.float32(0.0)
+    for ws, wt in zip(stud_weights, teacher_weights, strict=True):
+        wt = jax.lax.stop_gradient(wt)
+        logp_s = jax.nn.log_softmax(ws / T, axis=1)
+        p_t = jax.nn.softmax(wt / T, axis=1)
+        # torch F.kl_div default 'mean' = elementwise mean of
+        # p_t * log p_t - p_t * logp_s, with the 0·log 0 = 0 convention
+        # (xlogy) so an underflowed teacher probability yields 0, not NaN.
+        kl = jnp.mean(jax.scipy.special.xlogy(p_t, p_t) - p_t * logp_s)
+        total = total + kl * (T * T)
+    return total
+
+
+def loss_kd(stud_logits: Array, teacher_logits: Array, temperature: float = 6.0) -> Array:
+    """Hinton logit KD with T² scaling and torch's elementwise-mean
+    reduction (reference ``loss_kd``, ``utils/KD_loss.py:90-100``)."""
+    T = temperature
+    teacher_logits = jax.lax.stop_gradient(teacher_logits)
+    logp_s = jax.nn.log_softmax(stud_logits / T, axis=1)
+    p_t = jax.nn.softmax(teacher_logits / T, axis=1)
+    # elementwise mean; xlogy keeps 0·log 0 = 0 for saturated teacher rows
+    kl = jnp.mean(jax.scipy.special.xlogy(p_t, p_t) - p_t * logp_s)
+    return kl * (T * T)
+
+
+def match_conv_pairs(
+    stud_paths: Sequence[str],
+    teacher_paths: Sequence[str],
+    *,
+    skip_stem: bool = True,
+    skip_downsample: bool = True,
+) -> List[Tuple[str, str]]:
+    """One-time pairing of student/teacher conv weights for the layer KL.
+
+    Replaces the reference's per-batch O(L²) name-matched scan
+    (``utils/KD_loss.py:59-66``): name-equal conv pairs, skipping the
+    stem conv ('module.conv1' there; index 0 here) and any 'downsample'
+    path. Paths are the frameworks' ordered conv weight names
+    (see ``bdbnn_tpu.models.registry.conv_weight_paths``).
+
+    Parity note: the defaults reproduce ``DistributionLoss_layer`` (the
+    TS-loop loss). The softened CIFAR variant
+    (``DistributionLoss_layer_cifar_act``, ``utils/KD_loss.py:81-86``)
+    skips only the stem and DOES include downsample convs — pair for it
+    with ``skip_downsample=False``.
+    """
+    teacher_set = set(teacher_paths)
+    pairs = []
+    for i, p in enumerate(stud_paths):
+        if skip_stem and i == 0:
+            continue
+        if skip_downsample and "downsample" in p:
+            continue
+        if p in teacher_set:
+            pairs.append((p, p))
+    return pairs

@@ -1,0 +1,28 @@
+from bdbnn_tpu.nn import binarize, layers
+from bdbnn_tpu.nn.binarize import (
+    approx_sign,
+    binarize_weight,
+    ede_sign,
+    ste_sign,
+)
+from bdbnn_tpu.nn.layers import (
+    BinaryConv,
+    BinaryConvCifar,
+    BinaryConvReact,
+    LearnableBias,
+    RPReLU,
+)
+
+__all__ = [
+    "binarize",
+    "layers",
+    "ste_sign",
+    "approx_sign",
+    "ede_sign",
+    "binarize_weight",
+    "BinaryConv",
+    "BinaryConvCifar",
+    "BinaryConvReact",
+    "LearnableBias",
+    "RPReLU",
+]

@@ -1,0 +1,179 @@
+"""Binarization primitives as ``jax.custom_vjp`` transforms.
+
+The reference (BlueAnon/BD-BNN) implements these inside a ``models/``
+package that is absent from its snapshot; their behavior is recoverable
+from call sites (reference ``train.py:401-415``, ``utils/utils.py:8-14``)
+and the IR-Net / Bi-Real / ReActNet lineage the paper builds on:
+
+- ``ste_sign``        — sign forward, clipped-identity straight-through
+                        estimator backward (|x| <= 1 passes gradient).
+- ``approx_sign``     — sign forward, Bi-Real piecewise-polynomial
+                        backward (the derivative of the ApproxSign
+                        function): 2 - 2|x| on |x| < 1, else 0.
+- ``ede_sign``        — sign forward, IR-Net "error decay estimator"
+                        backward k·t·(1 - tanh²(t·x)). The reference
+                        anneals (t, k) per epoch and *mutates* them onto
+                        every conv module (``train.py:412-415``); here
+                        they are traced scalar arguments so the jitted
+                        step never retraces across epochs.
+- ``binarize_weight`` — XNOR-Net/ReActNet-style magnitude-aware weight
+                        binarization: sign(W) scaled by the per-output-
+                        channel mean |W| (scale detached), with a
+                        clipped-identity STE into the latent weights.
+
+All forwards use sign(x in {-1, +1}) with sign(0) := +1 — the binary-CNN
+convention (torch.sign's 0 would create a third value and break the
+±1 algebra of XNOR convolutions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _hard_sign(x: Array) -> Array:
+    """sign with sign(0) := +1, output in {-1, +1} of x.dtype."""
+    return jnp.where(x >= 0, 1.0, -1.0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# STE sign (clipped identity backward)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def ste_sign(x: Array) -> Array:
+    """sign(x) with the straight-through estimator backward.
+
+    Backward: dL/dx = dL/dy * 1{|x| <= 1} (clipped identity / "hard tanh"
+    estimator, the default for binarized activations and latent weights).
+    """
+    return _hard_sign(x)
+
+
+def _ste_sign_fwd(x):
+    return _hard_sign(x), x
+
+
+def _ste_sign_bwd(x, g):
+    return (g * (jnp.abs(x) <= 1.0).astype(g.dtype),)
+
+
+ste_sign.defvjp(_ste_sign_fwd, _ste_sign_bwd)
+
+
+# ---------------------------------------------------------------------------
+# ApproxSign (Bi-Real Net piecewise-polynomial backward)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def approx_sign(x: Array) -> Array:
+    """sign(x) with the Bi-Real-Net ApproxSign derivative backward.
+
+    Backward: dL/dx = dL/dy * (2 - 2|x|) on |x| < 1, else 0 — the
+    derivative of the piecewise quadratic that ReActNet also uses for
+    its RSign activations.
+    """
+    return _hard_sign(x)
+
+
+def _approx_sign_fwd(x):
+    return _hard_sign(x), x
+
+
+def _approx_sign_bwd(x, g):
+    slope = jnp.clip(2.0 - 2.0 * jnp.abs(x), 0.0, None)
+    return (g * slope.astype(g.dtype),)
+
+
+approx_sign.defvjp(_approx_sign_fwd, _approx_sign_bwd)
+
+
+# ---------------------------------------------------------------------------
+# EDE sign (IR-Net error-decay estimator, annealed tanh backward)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def ede_sign(x: Array, t: Array, k: Array) -> Array:
+    """sign(x) with the annealed IR-Net EDE backward k·t·(1 - tanh²(t·x)).
+
+    ``t`` anneals 1e-2 → 1e1 log-linearly over training and ``k = max(1/t, 1)``
+    (see :func:`bdbnn_tpu.train.ede.cpt_tk`, mirroring reference
+    ``utils/utils.py:6-14``). Early in training the estimator is wide and
+    smooth; late it sharpens toward the true (zero a.e.) derivative.
+
+    (t, k) are traced scalars: changing them per epoch does NOT retrace
+    the jitted train step, unlike the reference's module mutation
+    (``train.py:412-415``).
+    """
+    del t, k
+    return _hard_sign(x)
+
+
+def _ede_sign_fwd(x, t, k):
+    return _hard_sign(x), (x, t, k)
+
+
+def _ede_sign_bwd(res, g):
+    x, t, k = res
+    # sech²(t·x) computed directly (1 − tanh² loses precision to
+    # cancellation once |t·x| saturates tanh in f32; cosh overflow
+    # rounds cleanly to the correct 0 limit).
+    sech = 1.0 / jnp.cosh(t.astype(g.dtype) * x)
+    dx = g * (k.astype(g.dtype) * t.astype(g.dtype) * sech * sech)
+    return dx, jnp.zeros_like(t), jnp.zeros_like(k)
+
+
+ede_sign.defvjp(_ede_sign_fwd, _ede_sign_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Magnitude-aware weight binarization
+# ---------------------------------------------------------------------------
+
+
+def binarize_weight(w: Array, *, scaled: bool = True, estimator: str = "ste") -> Array:
+    """Binarize a conv/dense kernel to ±alpha with an STE into the latent weights.
+
+    ``w`` uses JAX HWIO layout (..., out_features): the scale alpha is the
+    mean |W| over all axes except the last (per output channel), matching
+    the XNOR-Net/ReActNet scaling the reference's missing
+    ``HardBinaryConv*`` modules implement (evidence: reference
+    ``train.py:30-32`` imports, arXiv:2204.02004 §3).
+
+    The scale is detached (``stop_gradient``) so gradients flow only
+    through the sign STE, as in ReActNet.
+    """
+    if estimator == "ste":
+        signed = ste_sign(w)
+    elif estimator == "approx":
+        signed = approx_sign(w)
+    else:
+        raise ValueError(f"unknown estimator: {estimator!r}")
+    if not scaled:
+        return signed
+    reduce_axes = tuple(range(w.ndim - 1))
+    alpha = jnp.mean(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    return signed * jax.lax.stop_gradient(alpha)
+
+
+def binarize_act(x: Array, *, estimator: str = "ste", tk=None) -> Array:
+    """Binarize activations to ±1 with the chosen gradient estimator.
+
+    ``tk``: optional ``(t, k)`` scalars switching to the EDE estimator
+    (used by the CIFAR variant under ``--ede``, reference
+    ``train.py:409-415``).
+    """
+    if tk is not None:
+        t, k = tk
+        return ede_sign(x, jnp.asarray(t, x.dtype), jnp.asarray(k, x.dtype))
+    if estimator == "ste":
+        return ste_sign(x)
+    if estimator == "approx":
+        return approx_sign(x)
+    raise ValueError(f"unknown estimator: {estimator!r}")

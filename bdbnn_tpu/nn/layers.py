@@ -1,0 +1,167 @@
+"""Binary conv modules (flax.linen), re-designed TPU-first.
+
+The reference's binary conv modules live in its missing ``models/``
+package; their contract is pinned by call sites (reference
+``train.py:30-32, 391-406``, ``utils/KD_loss.py:6-7``) and the
+ReActNet/IR-Net lineage:
+
+- ``BinaryConvReact``  ↔ ``HardBinaryConv_react``: RSign input
+  binarization (learnable per-channel shift + ApproxSign backward),
+  magnitude-scaled binary weights. Used by the ImageNet "react" recipe.
+- ``BinaryConv``       ↔ ``HardBinaryConv`` ("step 2" variant):
+  plain-STE input binarization, magnitude-scaled binary weights.
+- ``BinaryConvCifar``  ↔ ``HardBinaryConv_cifar``: CIFAR variant; its
+  input estimator can be switched to the annealed EDE by passing
+  ``tk`` (the reference pushes ``.k``/``.t`` onto conv modules per epoch,
+  ``train.py:412-415`` — here (t, k) are traced call arguments).
+
+Latent full-precision master weights are stored under the parameter name
+``float_weight`` so the kurtosis hook's QAT-name fallback (reference
+``train.py:404``) resolves identically.
+
+TPU notes: convs run in NHWC/HWIO (XLA's native TPU layout) and the ±1
+binarized operands stay in the input dtype (bf16-friendly) so XLA lowers
+them onto the MXU; there is an optional Pallas fast path in
+``bdbnn_tpu.nn.kernels``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from bdbnn_tpu.nn.binarize import approx_sign, binarize_act, binarize_weight
+
+Array = jax.Array
+
+
+def conv2d(
+    x: Array,
+    w: Array,
+    *,
+    strides: Tuple[int, int] = (1, 1),
+    padding="auto",
+    feature_group_count: int = 1,
+) -> Array:
+    """NHWC/HWIO conv. ``padding='auto'`` reproduces torch's symmetric
+    ``padding=k//2`` (NOT XLA 'SAME', whose asymmetric pad placement for
+    even inputs at stride 2 would shift features vs torch checkpoints)."""
+    if padding == "auto":
+        kh, kw = w.shape[0], w.shape[1]
+        padding = [(kh // 2, kh // 2), (kw // 2, kw // 2)]
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=feature_group_count,
+    )
+
+
+class LearnableBias(nn.Module):
+    """Per-channel learnable shift (ReActNet's "move" op)."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],))
+        return x + bias.astype(x.dtype)
+
+
+class RPReLU(nn.Module):
+    """ReActNet RPReLU: PReLU with learnable pre- and post-shifts.
+
+    f(x) = PReLU_beta(x - gamma) + zeta, all per-channel.
+    """
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        c = x.shape[-1]
+        gamma = self.param("gamma", nn.initializers.zeros, (c,))
+        zeta = self.param("zeta", nn.initializers.zeros, (c,))
+        slope = self.param(
+            "slope", nn.initializers.constant(0.25), (c,)
+        )
+        y = x - gamma.astype(x.dtype)
+        y = jnp.where(y >= 0, y, slope.astype(x.dtype) * y)
+        return y + zeta.astype(x.dtype)
+
+
+class _BinaryConvBase(nn.Module):
+    """Shared body: latent ``float_weight`` + magnitude-scaled binary conv."""
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "auto"
+
+    def latent_weight(self, in_features: int) -> Array:
+        shape = (*self.kernel_size, in_features, self.features)
+        return self.param(
+            "float_weight", nn.initializers.he_normal(), shape
+        )
+
+    def binary_conv(self, xb: Array, in_features: int) -> Array:
+        w = self.latent_weight(in_features).astype(xb.dtype)
+        wb = binarize_weight(w)
+        return conv2d(xb, wb, strides=self.strides, padding=self.padding)
+
+
+class BinaryConvReact(_BinaryConvBase):
+    """ReActNet-style binary conv: RSign(x - learnable shift) input,
+    sign(W)·mean|W| weights (↔ reference ``HardBinaryConv_react``,
+    imported at ``train.py:30``)."""
+
+    @nn.compact
+    def __call__(self, x: Array, tk=None) -> Array:
+        del tk  # react variant always uses the ApproxSign estimator
+        shift = self.param(
+            "act_shift", nn.initializers.zeros, (x.shape[-1],)
+        )
+        xb = approx_sign(x - shift.astype(x.dtype))
+        return self.binary_conv(xb, x.shape[-1])
+
+
+class BinaryConv(_BinaryConvBase):
+    """Plain-STE binary conv ("step 2" variant ↔ reference
+    ``HardBinaryConv``, imported at ``train.py:31``)."""
+
+    @nn.compact
+    def __call__(self, x: Array, tk=None) -> Array:
+        xb = binarize_act(x, estimator="ste", tk=tk)
+        return self.binary_conv(xb, x.shape[-1])
+
+
+class BinaryConvCifar(_BinaryConvBase):
+    """CIFAR binary conv (↔ reference ``HardBinaryConv_cifar``,
+    ``train.py:32``). Accepts ``tk=(t, k)`` to switch the input
+    estimator to the annealed EDE under ``--ede``."""
+
+    @nn.compact
+    def __call__(self, x: Array, tk=None) -> Array:
+        xb = binarize_act(x, estimator="ste", tk=tk)
+        return self.binary_conv(xb, x.shape[-1])
+
+
+class FloatConv(nn.Module):
+    """Full-precision conv with torch-compatible symmetric padding; the
+    teacher-side twin of the binary convs (weight param named ``weight``)."""
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x: Array, tk=None) -> Array:
+        del tk
+        shape = (*self.kernel_size, x.shape[-1], self.features)
+        w = self.param("weight", nn.initializers.he_normal(), shape)
+        y = conv2d(x, w.astype(x.dtype), strides=self.strides)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros, (self.features,))
+            y = y + b.astype(x.dtype)
+        return y

@@ -1,0 +1,27 @@
+"""Test harness: simulate an 8-device TPU-like mesh on CPU.
+
+The reference validated distributed behavior only by running on the
+authors' GPU cluster (SURVEY.md §4); here every distributed code path is
+exercised on a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count`` — the JAX-native analogue of a
+gloo/mock-NCCL DDP test.
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,104 @@
+"""Gradient-correctness tests for the binarization custom_vjps.
+
+Mirrors the test strategy SURVEY.md §4 prescribes: STE/EDE gradients vs
+the closed-form clipped-identity / polynomial / annealed-tanh estimators.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bdbnn_tpu.nn.binarize import (
+    approx_sign,
+    binarize_act,
+    binarize_weight,
+    ede_sign,
+    ste_sign,
+)
+
+X = jnp.array([-2.5, -1.0, -0.5, -0.0, 0.0, 0.3, 1.0, 1.7])
+
+
+def test_sign_forward_is_pm1():
+    for fn in (ste_sign, approx_sign):
+        y = fn(X)
+        np.testing.assert_array_equal(
+            np.asarray(y), np.array([-1, -1, -1, 1, 1, 1, 1, 1], np.float32)
+        )
+    y = ede_sign(X, jnp.float32(0.1), jnp.float32(10.0))
+    np.testing.assert_array_equal(
+        np.asarray(y), np.array([-1, -1, -1, 1, 1, 1, 1, 1], np.float32)
+    )
+
+
+def test_ste_grad_is_clipped_identity():
+    g = jax.grad(lambda x: ste_sign(x).sum())(X)
+    expect = (np.abs(np.asarray(X)) <= 1.0).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(g), expect)
+
+
+def test_approx_sign_grad_is_birealnet_polynomial():
+    g = jax.grad(lambda x: approx_sign(x).sum())(X)
+    xa = np.abs(np.asarray(X))
+    expect = np.where(xa < 1.0, 2.0 - 2.0 * xa, 0.0).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)
+
+
+def test_ede_grad_matches_closed_form():
+    for t, k in [(1e-2, 100.0), (0.5, 2.0), (10.0, 1.0)]:
+        g = jax.grad(
+            lambda x: ede_sign(x, jnp.float32(t), jnp.float32(k)).sum()
+        )(X)
+        expect = k * t * (1.0 - np.tanh(t * np.asarray(X)) ** 2)
+        np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-3, atol=1e-6)
+
+
+def test_ede_tk_change_does_not_retrace():
+    traces = []
+
+    @jax.jit
+    def f(x, t, k):
+        traces.append(1)
+        return ede_sign(x, t, k).sum()
+
+    f(X, jnp.float32(0.1), jnp.float32(10.0))
+    f(X, jnp.float32(5.0), jnp.float32(1.0))
+    assert len(traces) == 1
+
+
+def test_binarize_weight_values_and_scale():
+    w = jnp.array([[1.0, -2.0], [3.0, -4.0], [-0.5, 0.5]])  # (in=3, out=2)
+    b = binarize_weight(w)
+    alpha = np.mean(np.abs(np.asarray(w)), axis=0)  # per out-channel
+    np.testing.assert_allclose(
+        np.asarray(b), np.sign(np.asarray(w) + 1e-30) * alpha, rtol=1e-6
+    )
+
+
+def test_binarize_weight_grad_flows_through_ste_only():
+    w = jnp.array([[0.5, -2.0], [0.3, -0.1]])
+    g = jax.grad(lambda w: binarize_weight(w).sum())(w)
+    # scale detached: grad = alpha * 1{|w|<=1}
+    alpha = np.mean(np.abs(np.asarray(w)), axis=0)
+    expect = alpha[None, :] * (np.abs(np.asarray(w)) <= 1.0)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)
+
+
+def test_binarize_act_dispatch():
+    x = jnp.linspace(-2, 2, 8)
+    np.testing.assert_array_equal(
+        np.asarray(binarize_act(x)), np.asarray(ste_sign(x))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(binarize_act(x, estimator="approx")),
+        np.asarray(approx_sign(x)),
+    )
+    g = jax.grad(lambda x: binarize_act(x, tk=(0.5, 2.0)).sum())(x)
+    expect = 2.0 * 0.5 * (1 - np.tanh(0.5 * np.asarray(x)) ** 2)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-5)
+
+
+def test_binarization_under_jit_and_vmap():
+    f = jax.jit(jax.vmap(lambda x: ste_sign(x) * 2.0))
+    x = jnp.ones((4, 8)) * 0.5
+    np.testing.assert_allclose(np.asarray(f(x)), 2.0 * np.ones((4, 8)))

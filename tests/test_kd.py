@@ -1,0 +1,114 @@
+"""KD losses vs torch oracles reproducing reference ``utils/KD_loss.py``
+semantics exactly (incl. the raw-weight log_target KL quirk, SURVEY.md
+Appendix B #11)."""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from bdbnn_tpu.losses.kd import (
+    distribution_loss,
+    layer_weight_kl,
+    layer_weight_kl_softened,
+    loss_kd,
+    match_conv_pairs,
+    softmax_cross_entropy,
+)
+
+
+def test_softmax_cross_entropy_matches_torch(rng):
+    logits = rng.normal(size=(8, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(8,))
+    want = F.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels)
+    ).item()
+    got = float(
+        softmax_cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_distribution_loss_matches_reference_formula(rng):
+    s = rng.normal(size=(6, 10)).astype(np.float32)
+    t = rng.normal(size=(6, 10)).astype(np.float32)
+    # reference utils/KD_loss.py:25-37: batch-mean of -p_t . logp_s
+    pt = F.softmax(torch.tensor(t), dim=1)
+    logps = F.log_softmax(torch.tensor(s), dim=1)
+    want = (-(pt * logps).sum(dim=1)).mean().item()
+    got = float(distribution_loss(jnp.asarray(s), jnp.asarray(t)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_layer_weight_kl_matches_torch_kldivloss_log_target(rng):
+    shapes = [(3, 3, 4, 8), (1, 1, 8, 8)]
+    ws = [rng.normal(size=sh).astype(np.float32) * 0.1 for sh in shapes]
+    wt = [rng.normal(size=sh).astype(np.float32) * 0.1 for sh in shapes]
+    crit = torch.nn.KLDivLoss(log_target=True)
+    want = sum(
+        crit(torch.tensor(a), torch.tensor(b)).item()
+        for a, b in zip(ws, wt)
+    )
+    got = float(
+        layer_weight_kl(
+            [jnp.asarray(a) for a in ws], [jnp.asarray(b) for b in wt]
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_layer_weight_kl_softened_matches_torch(rng):
+    sh = (8, 4, 3, 3)  # torch OIHW layout; loss softmaxes over axis 1
+    ws = rng.normal(size=sh).astype(np.float32)
+    wt = rng.normal(size=sh).astype(np.float32)
+    T = 6.0
+    want = (
+        F.kl_div(
+            F.log_softmax(torch.tensor(ws) / T, dim=1),
+            F.softmax(torch.tensor(wt) / T, dim=1),
+        )
+        * (T * T)
+    ).item()
+    got = float(
+        layer_weight_kl_softened([jnp.asarray(ws)], [jnp.asarray(wt)], T)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+
+def test_loss_kd_matches_torch(rng):
+    s = rng.normal(size=(5, 10)).astype(np.float32)
+    t = rng.normal(size=(5, 10)).astype(np.float32)
+    T = 4.0
+    want = (
+        F.kl_div(
+            F.log_softmax(torch.tensor(s) / T, dim=1),
+            F.softmax(torch.tensor(t) / T, dim=1),
+        )
+        * (T * T)
+    ).item()
+    got = float(loss_kd(jnp.asarray(s), jnp.asarray(t), T))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+
+def test_teacher_gets_no_gradient(rng):
+    import jax
+
+    s = jnp.asarray(rng.normal(size=(4, 10)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=(4, 10)).astype(np.float32))
+    gt = jax.grad(lambda t: distribution_loss(s, t))(t)
+    np.testing.assert_array_equal(np.asarray(gt), 0.0)
+
+
+def test_match_conv_pairs_skips_stem_and_downsample():
+    paths = [
+        "stem/weight",
+        "layer1/block0/conv1/float_weight",
+        "layer1/block0/conv2/float_weight",
+        "layer2/block0/downsample/weight",
+        "layer2/block0/conv1/float_weight",
+    ]
+    pairs = match_conv_pairs(paths, paths)
+    names = [p[0] for p in pairs]
+    assert "stem/weight" not in names
+    assert not any("downsample" in n for n in names)
+    assert len(pairs) == 3

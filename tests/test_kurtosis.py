@@ -1,0 +1,110 @@
+"""Kurtosis regularizer vs a torch oracle reproducing reference
+``kurtosis.py`` semantics (incl. the Bessel-corrected std trap,
+SURVEY.md Appendix B #10)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from bdbnn_tpu.losses.kurtosis import (
+    DIFFKURT_TARGETS_CIFAR,
+    DIFFKURT_TARGETS_IMAGENET,
+    DIFFKURT_TARGETS_TS,
+    kurtosis,
+    kurtosis_loss,
+    kurtosis_regularization,
+    l2_regularization,
+    resolve_targets,
+    weight_to_pm1_regularization,
+)
+
+
+def torch_kurtosis(w):
+    w = torch.tensor(w)
+    mean = torch.mean(w)
+    std = torch.std(w)  # Bessel-corrected, as reference kurtosis.py:25
+    return torch.mean(((w - mean) / std) ** 4).item()
+
+
+def test_kurtosis_matches_torch_oracle(rng):
+    for shape in [(64,), (3, 3, 16, 32), (7, 11)]:
+        w = rng.normal(size=shape).astype(np.float32)
+        got = float(kurtosis(jnp.asarray(w)))
+        want = torch_kurtosis(w)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_kurtosis_loss_squared_error(rng):
+    w = rng.normal(size=(128,)).astype(np.float32)
+    got = float(kurtosis_loss(jnp.asarray(w), 1.8))
+    want = (torch_kurtosis(w) - 1.8) ** 2
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_cross_layer_reduction_modes(rng):
+    ws = [rng.normal(size=(32,)).astype(np.float32) for _ in range(3)]
+    targets = [1.8, 1.4, 1.2]
+    per_layer = np.array(
+        [(torch_kurtosis(w) - t) ** 2 for w, t in zip(ws, targets)]
+    )
+    jws = [jnp.asarray(w) for w in ws]
+    np.testing.assert_allclose(
+        float(kurtosis_regularization(jws, targets, "sum")),
+        per_layer.sum(),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(kurtosis_regularization(jws, targets, "avg")),
+        per_layer.mean(),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(kurtosis_regularization(jws, targets, "max")),
+        per_layer.max(),
+        rtol=1e-4,
+    )
+
+
+def test_l2_and_pm1_regularizers(rng):
+    ws = [rng.normal(size=(4, 5)).astype(np.float32) for _ in range(2)]
+    jws = [jnp.asarray(w) for w in ws]
+    np.testing.assert_allclose(
+        float(l2_regularization(jws)),
+        sum((w**2).sum() for w in ws),
+        rtol=1e-5,
+    )
+    want = sum(
+        torch.norm(torch.abs(torch.tensor(w)) - 1, p=2).item() for w in ws
+    )
+    np.testing.assert_allclose(
+        float(weight_to_pm1_regularization(jws)), want, rtol=1e-5
+    )
+
+
+def test_diffkurt_tables_have_19_entries():
+    # 19 binarized convs in the ResNet-18-shaped flagship (train.py:467-475)
+    for t in (
+        DIFFKURT_TARGETS_IMAGENET,
+        DIFFKURT_TARGETS_CIFAR,
+        DIFFKURT_TARGETS_TS,
+    ):
+        assert len(t) == 19
+
+
+def test_resolve_targets():
+    assert resolve_targets(5, scalar_target=1.8) == (1.8,) * 5
+    assert (
+        resolve_targets(19, diffkurt=True, dataset="imagenet")
+        == DIFFKURT_TARGETS_IMAGENET
+    )
+    assert (
+        resolve_targets(19, diffkurt=True, dataset="cifar10")
+        == DIFFKURT_TARGETS_CIFAR
+    )
+    assert (
+        resolve_targets(19, diffkurt=True, teacher_student=True)
+        == DIFFKURT_TARGETS_TS
+    )
+    with pytest.raises(ValueError):
+        resolve_targets(7, diffkurt=True)
