@@ -1,0 +1,169 @@
+"""CLI front-end — drop-in replacement for ``python train.py DATA
+[flags]`` (reference ``train.py:64-171``; full table SURVEY.md
+Appendix A).
+
+Every reference flag is accepted. GPU/NCCL-era flags (``--gpu``,
+``--world-size``, ``--rank``, ``--dist-url``, ``--dist-backend``,
+``--master-addr``, ``--multiprocessing-distributed``) parse but are
+ignored with a warning: on TPU the pod is discovered by
+``jax.distributed.initialize()`` and data parallelism is compiled into
+the step (SURVEY.md §5.8) — there is nothing to configure.
+
+Usage:  python -m bdbnn_tpu.cli DATA --dataset cifar10 -a resnet18 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from bdbnn_tpu.configs.config import RunConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="BD-BNN TPU training")
+    p.add_argument("data", nargs="?", default="", help="dataset directory")
+    p.add_argument("-a", "--arch", default="resnet18")
+    p.add_argument("-j", "--workers", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=90)
+    p.add_argument("--start-epoch", type=int, default=0)
+    p.add_argument("-b", "--batch-size", type=int, default=256)
+    p.add_argument("-lr", "--learning-rate", type=float, default=0.1, dest="lr")
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("-wd", "--weight-decay", type=float, default=1e-4)
+    p.add_argument("-p", "--print-freq", type=int, default=10)
+    p.add_argument("--resume", default="", type=str)
+    p.add_argument("-e", "--evaluate", action="store_true")
+    p.add_argument("--pretrained", action="store_true")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--log_path", default="log", type=str)
+    p.add_argument("--custom_resnet", action="store_true", default=True)
+    p.add_argument("--reset_resume", action="store_true")
+    p.add_argument("--ede", action="store_true")
+    p.add_argument("--w-kurtosis-target", type=float, default=1.8)
+    p.add_argument("--w-lambda-kurtosis", type=float, default=1.0)
+    p.add_argument("--w-kurtosis", action="store_true")
+    p.add_argument("--weight-name", nargs="+", default=["all"])
+    p.add_argument("--remove-weight-name", nargs="+", default=[])
+    p.add_argument("--kurtosis-mode", default="avg", choices=["max", "sum", "avg"])
+    p.add_argument("--diffkurt", action="store_true")
+    p.add_argument("--kurtepoch", type=int, default=0)
+    p.add_argument("--twoblock", action="store_true")
+    p.add_argument(
+        "--dataset", default="cifar10",
+        choices=["cifar10", "cifar100", "imagenet"],
+    )
+    # Appendix B #2/#3 fixes: real flags
+    p.add_argument("--w-l2-reg", action="store_true")
+    p.add_argument("--w-lambda-l2", type=float, default=0.0)
+    p.add_argument("--w-wr-reg", action="store_true")
+    p.add_argument("--w-lambda-wr", type=float, default=0.0)
+    p.add_argument("--w-lambda-ce", type=float, default=1.0)
+    # teacher-student
+    p.add_argument("--imagenet_setting", action="store_true")
+    p.add_argument("--imagenet_setting_step_1", action="store_true")
+    p.add_argument("--imagenet_setting_step_2", action="store_true")
+    p.add_argument("--imagenet_setting_step_2_ts", action="store_true")
+    p.add_argument("-a_teacher", "--arch_teacher", default="resnet18_float")
+    p.add_argument("--custom_resnet_teacher", action="store_true")
+    p.add_argument("--resume_teacher", default="", type=str)
+    p.add_argument("--kd", action="store_true")
+    p.add_argument("--react", action="store_true")
+    p.add_argument("--alpha", type=float, default=0.9)
+    p.add_argument("--temperature", type=float, default=4)
+    p.add_argument("--beta", type=float, default=200)
+    p.add_argument("--qk_dim", type=int, default=128)
+    # TPU-native parallelism
+    p.add_argument("--model-parallel", type=int, default=1)
+    p.add_argument(
+        "--distributed-init", action="store_true",
+        help="call jax.distributed.initialize() (multi-host pods)",
+    )
+    # legacy GPU/NCCL flags: accepted, ignored
+    for flag, kw in [
+        ("--world-size", dict(type=int, default=1)),
+        ("--rank", dict(type=int, default=0)),
+        ("--dist-url", dict(type=str, default="")),
+        ("--master-addr", dict(type=str, default="")),
+        ("--dist-backend", dict(type=str, default="")),
+        ("--gpu", dict(type=int, default=None)),
+        ("--multiprocessing-distributed", dict(action="store_true")),
+    ]:
+        p.add_argument(flag, **kw)
+    return p
+
+
+_LEGACY = [
+    ("world_size", 1), ("rank", 0), ("dist_url", ""), ("master_addr", ""),
+    ("dist_backend", ""), ("gpu", None), ("multiprocessing_distributed", False),
+]
+
+
+def args_to_config(args: argparse.Namespace) -> RunConfig:
+    for name, default in _LEGACY:
+        if getattr(args, name) != default:
+            print(
+                f"[bdbnn_tpu] note: --{name.replace('_', '-')} is a GPU/NCCL-era "
+                "flag with no TPU equivalent; ignored "
+                "(jax.distributed.initialize discovers the pod).",
+                file=sys.stderr,
+            )
+    return RunConfig(
+        data=args.data,
+        dataset=args.dataset,
+        workers=args.workers,
+        arch=args.arch,
+        custom_resnet=args.custom_resnet,
+        pretrained=args.pretrained,
+        twoblock=args.twoblock,
+        epochs=args.epochs,
+        start_epoch=args.start_epoch,
+        batch_size=args.batch_size,
+        lr=args.lr,
+        momentum=args.momentum,
+        weight_decay=args.weight_decay,
+        print_freq=args.print_freq,
+        log_path=args.log_path,
+        resume=args.resume,
+        reset_resume=args.reset_resume,
+        evaluate=args.evaluate,
+        seed=args.seed,
+        ede=args.ede,
+        w_kurtosis=args.w_kurtosis,
+        w_kurtosis_target=args.w_kurtosis_target,
+        w_lambda_kurtosis=args.w_lambda_kurtosis,
+        weight_name=tuple(args.weight_name),
+        remove_weight_name=tuple(args.remove_weight_name),
+        kurtosis_mode=args.kurtosis_mode,
+        diffkurt=args.diffkurt,
+        kurtepoch=args.kurtepoch,
+        w_l2_reg=args.w_l2_reg,
+        w_lambda_l2=args.w_lambda_l2,
+        w_wr_reg=args.w_wr_reg,
+        w_lambda_wr=args.w_lambda_wr,
+        imagenet_setting_step_2_ts=args.imagenet_setting_step_2_ts,
+        arch_teacher=args.arch_teacher,
+        custom_resnet_teacher=args.custom_resnet_teacher,
+        resume_teacher=args.resume_teacher,
+        react=args.react,
+        alpha=args.alpha,
+        temperature=args.temperature,
+        beta=args.beta,
+        w_lambda_ce=args.w_lambda_ce,
+        model_parallel=args.model_parallel,
+        distributed_init=args.distributed_init,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = args_to_config(args)
+    from bdbnn_tpu.train.loop import fit
+
+    result = fit(cfg)
+    print(result)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,1 +1,4 @@
+from bdbnn_tpu.configs import config
+from bdbnn_tpu.configs.config import RunConfig
 
+__all__ = ["config", "RunConfig"]

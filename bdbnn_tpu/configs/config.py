@@ -1,0 +1,97 @@
+"""Typed run configuration — the reference's ~45-flag argparse surface
+(SURVEY.md Appendix A) as an immutable dataclass.
+
+Where the reference mutates its namespace at runtime (per-layer target
+overwrite ``train.py:465-477``, per-proc batch division
+``train.py:302-303``, react overrides ``train.py:605-609``), this
+config is resolved once before the jitted step is built.
+
+Appendix-B fixes are explicit fields: ``w_l2_reg`` / ``w_wr_reg``
+(read-but-undefined in the reference, #2) and ``w_lambda_ce``
+(undefined for non-react TS runs, #3) exist with sane defaults.
+Dropped as obsolete-by-design: NCCL/rendezvous flags (``--dist-url``,
+``--dist-backend``, ``--master-addr``, ``--multiprocessing-distributed``
+— replaced by ``jax.distributed.initialize``; SURVEY.md §5.8), and
+``--gpu`` pinning. They are still *accepted* by the CLI for drop-in
+compatibility but ignored with a warning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    # data
+    data: str = ""  # dataset dir (positional in the reference)
+    dataset: str = "cifar10"  # cifar10 | cifar100 | imagenet
+    workers: int = 4
+    # model
+    arch: str = "resnet18"
+    custom_resnet: bool = True
+    pretrained: bool = False
+    twoblock: bool = False  # parsed-but-unused in the reference; kept
+    # schedule
+    epochs: int = 90
+    start_epoch: int = 0
+    batch_size: int = 256
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    # logging / checkpoint
+    print_freq: int = 10
+    log_path: str = "log"
+    resume: str = ""
+    reset_resume: bool = False
+    evaluate: bool = False
+    seed: Optional[int] = None
+    # EDE
+    ede: bool = False
+    # kurtosis
+    w_kurtosis: bool = False
+    w_kurtosis_target: float = 1.8
+    w_lambda_kurtosis: float = 1.0
+    weight_name: Tuple[str, ...] = ("all",)
+    remove_weight_name: Tuple[str, ...] = ()
+    kurtosis_mode: str = "avg"  # avg | sum | max
+    diffkurt: bool = False
+    kurtepoch: int = 0
+    # aux regularizers (Appendix B #2 — real flags now)
+    w_l2_reg: bool = False
+    w_lambda_l2: float = 0.0
+    w_wr_reg: bool = False
+    w_lambda_wr: float = 0.0
+    # teacher-student
+    imagenet_setting_step_2_ts: bool = False
+    arch_teacher: str = "resnet18_float"
+    custom_resnet_teacher: bool = False
+    resume_teacher: str = ""
+    react: bool = False
+    alpha: float = 0.9
+    temperature: float = 4.0
+    beta: float = 200.0
+    w_lambda_ce: float = 1.0  # Appendix B #3 fix: defined, default 1
+    # parallelism (TPU-native; replaces world-size/rank/dist-* flags)
+    model_parallel: int = 1
+    distributed_init: bool = False  # call jax.distributed.initialize()
+    # compute
+    dtype: str = "float32"  # float32 | bfloat16 activations
+
+    @property
+    def num_classes(self) -> int:
+        return {"cifar10": 10, "cifar100": 100, "imagenet": 1000}[self.dataset]
+
+    @property
+    def teacher_student(self) -> bool:
+        return self.imagenet_setting_step_2_ts
+
+    def validate(self) -> "RunConfig":
+        if self.dataset not in ("cifar10", "cifar100", "imagenet"):
+            raise ValueError(f"unknown dataset {self.dataset!r}")
+        if self.kurtosis_mode not in ("avg", "sum", "max"):
+            raise ValueError(f"unknown kurtosis mode {self.kurtosis_mode!r}")
+        if self.batch_size <= 0 or self.epochs <= 0:
+            raise ValueError("batch_size and epochs must be positive")
+        return self

@@ -1,1 +1,40 @@
+from bdbnn_tpu.data import datasets, pipeline
+from bdbnn_tpu.data.datasets import (
+    CIFAR_MEAN,
+    CIFAR_STD,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ArrayDataset,
+    ImageFolder,
+    load_cifar10,
+    load_cifar100,
+    synthetic_dataset,
+)
+from bdbnn_tpu.data.pipeline import (
+    ImageFolderPipeline,
+    Pipeline,
+    cifar_eval_transform,
+    cifar_train_augment,
+    host_shard_indices,
+    normalize,
+)
 
+__all__ = [
+    "datasets",
+    "pipeline",
+    "CIFAR_MEAN",
+    "CIFAR_STD",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "ArrayDataset",
+    "ImageFolder",
+    "load_cifar10",
+    "load_cifar100",
+    "synthetic_dataset",
+    "ImageFolderPipeline",
+    "Pipeline",
+    "cifar_eval_transform",
+    "cifar_train_augment",
+    "host_shard_indices",
+    "normalize",
+]

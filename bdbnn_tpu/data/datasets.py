@@ -1,0 +1,160 @@
+"""Dataset sources: CIFAR-10/100 binary batches, ImageFolder, synthetic.
+
+Replaces the reference's torchvision dataset objects (reference
+``loader.py:26, 48, 57, 73``) with dependency-light loaders:
+
+- CIFAR from the standard python pickle batches (``cifar-10-batches-py``
+  / ``cifar-100-python``) or an ``.npz`` with ``x_train/y_train/
+  x_test/y_test`` — no network download (zero-egress environment; the
+  reference called ``download=True``).
+- ImageFolder: class-per-subdirectory JPEG/PNG tree, decoded with PIL
+  (baked in via torchvision).
+- Synthetic: deterministic random images/labels with the same shapes —
+  used by tests and the benchmark harness.
+
+All sources return uint8 HWC images + int labels; normalization and
+augmentation happen in :mod:`bdbnn_tpu.data.pipeline`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Reference normalization constants (loader.py:13, 37, 53-54).
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class ArrayDataset:
+    """In-memory uint8 images (N, H, W, C) + int64 labels (N,)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray):
+        assert images.ndim == 4 and images.dtype == np.uint8
+        assert len(images) == len(labels)
+        self.images = images
+        self.labels = labels.astype(np.int64)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+
+def synthetic_dataset(
+    num_examples: int = 512,
+    image_size: int = 32,
+    num_classes: int = 10,
+    seed: int = 0,
+) -> ArrayDataset:
+    rng = np.random.default_rng(seed)
+    images = rng.integers(
+        0, 256, size=(num_examples, image_size, image_size, 3), dtype=np.uint8
+    )
+    labels = rng.integers(0, num_classes, size=(num_examples,))
+    return ArrayDataset(images, labels)
+
+
+def _load_cifar_pickle(path: str):
+    with open(path, "rb") as f:
+        return pickle.load(f, encoding="bytes")
+
+
+def load_cifar10(data_root: str, split: str = "train") -> ArrayDataset:
+    """Standard ``cifar-10-batches-py`` layout (data_batch_1..5 /
+    test_batch) or an npz fallback."""
+    npz = _try_npz(data_root, split)
+    if npz is not None:
+        return npz
+    base = os.path.join(data_root, "cifar-10-batches-py")
+    if not os.path.isdir(base):
+        base = data_root
+    files = (
+        [f"data_batch_{i}" for i in range(1, 6)]
+        if split == "train"
+        else ["test_batch"]
+    )
+    imgs: List[np.ndarray] = []
+    labels: List[np.ndarray] = []
+    for fn in files:
+        d = _load_cifar_pickle(os.path.join(base, fn))
+        imgs.append(np.asarray(d[b"data"], np.uint8))
+        labels.append(np.asarray(d[b"labels"], np.int64))
+    x = np.concatenate(imgs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return ArrayDataset(np.ascontiguousarray(x), np.concatenate(labels))
+
+
+def load_cifar100(data_root: str, split: str = "train") -> ArrayDataset:
+    npz = _try_npz(data_root, split)
+    if npz is not None:
+        return npz
+    base = os.path.join(data_root, "cifar-100-python")
+    if not os.path.isdir(base):
+        base = data_root
+    d = _load_cifar_pickle(
+        os.path.join(base, "train" if split == "train" else "test")
+    )
+    x = (
+        np.asarray(d[b"data"], np.uint8)
+        .reshape(-1, 3, 32, 32)
+        .transpose(0, 2, 3, 1)
+    )
+    return ArrayDataset(
+        np.ascontiguousarray(x), np.asarray(d[b"fine_labels"], np.int64)
+    )
+
+
+def _try_npz(data_root: str, split: str) -> Optional[ArrayDataset]:
+    for name in ("data.npz", f"{split}.npz"):
+        p = os.path.join(data_root, name)
+        if os.path.isfile(p):
+            z = np.load(p)
+            if f"x_{split}" in z:
+                return ArrayDataset(
+                    z[f"x_{split}"].astype(np.uint8), z[f"y_{split}"]
+                )
+            if "images" in z:
+                return ArrayDataset(z["images"].astype(np.uint8), z["labels"])
+    return None
+
+
+class ImageFolder:
+    """Class-per-subdirectory image tree (↔ torchvision ImageFolder,
+    reference ``loader.py:57, 73``). Lazily decodes with PIL; sorted
+    class names → indices, matching torchvision's convention so label
+    spaces agree with torch-trained teachers."""
+
+    EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+    def __init__(self, root: str):
+        self.root = root
+        classes = sorted(
+            d
+            for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise FileNotFoundError(f"no class subdirectories under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, filenames in sorted(os.walk(cdir)):
+                for fn in sorted(filenames):
+                    if fn.lower().endswith(self.EXTS):
+                        self.samples.append(
+                            (os.path.join(dirpath, fn), self.class_to_idx[c])
+                        )
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def load(self, index: int):
+        from PIL import Image
+
+        path, label = self.samples[index]
+        with Image.open(path) as im:
+            return im.convert("RGB"), label

@@ -1,1 +1,32 @@
+from bdbnn_tpu.models import cifar10, imagenet, registry, resnet, torch_import
+from bdbnn_tpu.models.registry import create_model, list_models
+from bdbnn_tpu.models.resnet import (
+    BiBasicBlock,
+    BiResNet,
+    VGGSmallBinary,
+    conv_weight_paths,
+    get_by_path,
+    module_path_str,
+)
+from bdbnn_tpu.models.torch_import import (
+    convert_torch_state_dict,
+    load_torch_checkpoint,
+)
 
+__all__ = [
+    "cifar10",
+    "imagenet",
+    "registry",
+    "resnet",
+    "torch_import",
+    "create_model",
+    "list_models",
+    "BiBasicBlock",
+    "BiResNet",
+    "VGGSmallBinary",
+    "conv_weight_paths",
+    "get_by_path",
+    "module_path_str",
+    "convert_torch_state_dict",
+    "load_torch_checkpoint",
+]

@@ -1,0 +1,24 @@
+"""CIFAR model namespace — reference-API parity shim.
+
+The reference does ``from models import cifar10 as cifar_models`` and
+builds via ``cifar_models.__dict__[args.arch]()`` (reference
+``train.py:27, 50-52, 257, 283``). This module exposes the same
+constructor-by-name surface over the registry.
+"""
+
+from bdbnn_tpu.models.registry import cifar_model_factories
+
+_factories = cifar_model_factories(num_classes=10)
+
+
+def __getattr__(name: str):
+    if name in _factories:
+        return _factories[name]
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(_factories)
+
+
+globals().update(_factories)

@@ -1,0 +1,25 @@
+"""ImageNet model namespace — reference-API parity shim.
+
+The reference does ``from models import imagenet as imagenet_models``
+and builds via ``imagenet_models.__dict__[args.arch](pretrained=...)``
+(reference ``train.py:28, 54-56, 253, 285``). Same surface here; the
+``pretrained`` flag is accepted (weights come from
+``bdbnn_tpu.models.torch_import`` — no network egress).
+"""
+
+from bdbnn_tpu.models.registry import imagenet_model_factories
+
+_factories = imagenet_model_factories(num_classes=1000)
+
+
+def __getattr__(name: str):
+    if name in _factories:
+        return _factories[name]
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(_factories)
+
+
+globals().update(_factories)

@@ -1,0 +1,109 @@
+"""Model registry — constructor-by-name, mirroring the reference's
+``models.cifar10.__dict__[arch]()`` / ``models.imagenet.__dict__[arch](
+pretrained=...)`` contract (reference ``train.py:50-56, 257, 283-288``).
+
+Binary model naming:
+
+- ``resnet18`` / ``resnet34``   — binary (react variant on imagenet,
+  EDE-able plain-STE variant on cifar); what ``--custom_resnet``
+  selects in the reference.
+- ``resnet18_step2`` etc.       — the "set_2_2" plain-STE variant
+  (binarize weights and activations with plain STE).
+- ``resnet18_float`` / ``resnet20_float`` — full-precision twins used
+  as KD teachers (↔ torchvision models in the reference,
+  ``train.py:253-258, 287-288``).
+- ``resnet20`` / ``vgg_small``  — CIFAR extras from the classic BNN
+  acceptance matrix (BASELINE config 1 uses binary ResNet-20).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict
+
+from bdbnn_tpu.models.resnet import BiResNet, VGGSmallBinary
+
+
+def _cifar_classes(dataset: str) -> int:
+    return {"cifar10": 10, "cifar100": 100}[dataset]
+
+
+def _make_cifar(name, stage_sizes, width, variant, act, num_classes):
+    return BiResNet(
+        stage_sizes=stage_sizes,
+        num_classes=num_classes,
+        width=width,
+        stem="cifar",
+        variant=variant,
+        act=act,
+    )
+
+
+def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000, pretrained=False):
+    # ``pretrained`` accepted for reference-API parity; weight loading
+    # happens via bdbnn_tpu.models.torch_import (no network egress).
+    del pretrained
+    return BiResNet(
+        stage_sizes=stage_sizes,
+        num_classes=num_classes,
+        width=64,
+        stem="imagenet",
+        variant=variant,
+        act=act,
+    )
+
+
+def cifar_model_factories(num_classes: int = 10) -> Dict[str, Callable]:
+    f = functools.partial
+    return {
+        # binary (EDE-able plain-STE CIFAR convs, hardtanh blocks)
+        "resnet18": f(_make_cifar, "resnet18", (2, 2, 2, 2), 64, "cifar", "hardtanh", num_classes),
+        "resnet20": f(_make_cifar, "resnet20", (3, 3, 3), 16, "cifar", "hardtanh", num_classes),
+        "resnet34": f(_make_cifar, "resnet34", (3, 4, 6, 3), 64, "cifar", "hardtanh", num_classes),
+        # react-style CIFAR (RSign/RPReLU)
+        "resnet18_react": f(_make_cifar, "resnet18_react", (2, 2, 2, 2), 64, "react", "rprelu", num_classes),
+        "resnet20_react": f(_make_cifar, "resnet20_react", (3, 3, 3), 16, "react", "rprelu", num_classes),
+        # FP teachers
+        "resnet18_float": f(_make_cifar, "resnet18_float", (2, 2, 2, 2), 64, "float", "identity", num_classes),
+        "resnet20_float": f(_make_cifar, "resnet20_float", (3, 3, 3), 16, "float", "identity", num_classes),
+        "resnet34_float": f(_make_cifar, "resnet34_float", (3, 4, 6, 3), 64, "float", "identity", num_classes),
+        "vgg_small": f(VGGSmallBinary, num_classes),
+    }
+
+
+def imagenet_model_factories(num_classes: int = 1000) -> Dict[str, Callable]:
+    f = functools.partial
+    return {
+        # react variant == reference resnet_bi_imagenet_set_2
+        "resnet18": f(_make_imagenet, "resnet18", (2, 2, 2, 2), "react", "rprelu", num_classes),
+        "resnet34": f(_make_imagenet, "resnet34", (3, 4, 6, 3), "react", "rprelu", num_classes),
+        "resnet18_react": f(_make_imagenet, "resnet18_react", (2, 2, 2, 2), "react", "rprelu", num_classes),
+        "resnet34_react": f(_make_imagenet, "resnet34_react", (3, 4, 6, 3), "react", "rprelu", num_classes),
+        # step-2 variant == reference resnet_bi_imagenet_set_2_2
+        "resnet18_step2": f(_make_imagenet, "resnet18_step2", (2, 2, 2, 2), "step2", "hardtanh", num_classes),
+        "resnet34_step2": f(_make_imagenet, "resnet34_step2", (3, 4, 6, 3), "step2", "hardtanh", num_classes),
+        # FP teachers (↔ torchvision resnet18/34)
+        "resnet18_float": f(_make_imagenet, "resnet18_float", (2, 2, 2, 2), "float", "identity", num_classes),
+        "resnet34_float": f(_make_imagenet, "resnet34_float", (3, 4, 6, 3), "float", "identity", num_classes),
+    }
+
+
+def create_model(arch: str, dataset: str = "cifar10", **kwargs):
+    """Build a model by (arch, dataset) — the registry front door."""
+    if dataset in ("cifar10", "cifar100"):
+        factories = cifar_model_factories(_cifar_classes(dataset))
+    elif dataset == "imagenet":
+        factories = imagenet_model_factories(kwargs.pop("num_classes", 1000))
+    else:
+        raise ValueError(f"unknown dataset: {dataset!r}")
+    if arch not in factories:
+        raise ValueError(
+            f"unknown arch {arch!r} for {dataset}; have {sorted(factories)}"
+        )
+    return factories[arch](**kwargs)
+
+
+def list_models(dataset: str = "cifar10"):
+    if dataset == "imagenet":
+        return sorted(imagenet_model_factories())
+    return sorted(cifar_model_factories())

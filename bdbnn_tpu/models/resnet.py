@@ -1,0 +1,295 @@
+"""Binary ResNet model families, re-designed TPU-first.
+
+The reference's ``models/`` package is missing from its snapshot
+(SURVEY.md §0.2); these models are re-derived from the paper
+(arXiv:2204.02004) + the Bi-Real/IR-Net/ReActNet lineage + the hard
+behavioral constraints recoverable from reference call sites:
+
+- flagship = ResNet-18-shaped net with 20 convs, 19 of them binarized /
+  kurtosis-regularized (the ``all_convs[1:]`` selector at reference
+  ``train.py:390-393`` and the 19-entry ``--diffkurt`` tables at
+  ``train.py:467-475``);
+- binary convs keep latent FP master weights addressable as
+  ``float_weight`` (QAT-name fallback, reference ``train.py:404``);
+- a ReActNet-style variant (``HardBinaryConv_react``, ``train.py:30``),
+  a plain-STE "step 2" variant (``HardBinaryConv``, ``train.py:31``),
+  and a CIFAR variant (``HardBinaryConv_cifar``, ``train.py:32``) that
+  accepts the annealed EDE estimator (``train.py:409-415``).
+
+Architecture notes (TPU-first, not a torch translation):
+
+- NHWC activations / HWIO kernels throughout — XLA's native TPU conv
+  layout, so the ±1 bf16 operands tile straight onto the MXU.
+- Each binary 3x3 conv is its own residual unit (Bi-Real "shortcut per
+  conv"): ``y = act(BN(BinConv(x)) + shortcut)``. This keeps an FP
+  information path around every 1-bit conv — essential for BNN accuracy
+  and free on TPU (the add fuses into the conv epilogue).
+- Downsample shortcuts use AvgPool + binary 1x1 conv (Bi-Real recipe);
+  the FP teacher twins use torchvision's strided 1x1 conv + BN so torch
+  teacher checkpoints can be ingested weight-for-weight.
+- Module names mirror torch ResNet (``conv1``/``bn1``/``layerS_B``/
+  ``downsample_conv``/``fc``) so student/teacher conv pairing and the
+  kurtosis hook selection work by path equality, and so that the
+  alphabetical flax param ordering reproduces torch's
+  ``named_parameters`` conv order (conv1 < conv2 < downsample_conv).
+
+BatchNorm uses torch-default effective momentum (torch 0.1 == flax 0.9)
+and eps 1e-5 for teacher-checkpoint parity.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from bdbnn_tpu.nn.layers import (
+    BinaryConv,
+    BinaryConvCifar,
+    BinaryConvReact,
+    FloatConv,
+    RPReLU,
+)
+
+Array = jax.Array
+
+_CONV_CLASSES = {
+    "react": BinaryConvReact,
+    "step2": BinaryConv,
+    "cifar": BinaryConvCifar,
+    "float": FloatConv,
+}
+
+
+def _batch_norm(train: bool, name: str) -> nn.BatchNorm:
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=0.9,
+        epsilon=1e-5,
+        name=name,
+    )
+
+
+def _activation(kind: str, name: str) -> Callable[[Array], Array]:
+    """Post-add activation of a residual unit.
+
+    - 'rprelu': ReActNet RPReLU (learnable, react variant);
+    - 'hardtanh': clip(-1, 1) (IR-Net-style plain/cifar variants — ReLU
+      would collapse the following sign() to all-ones);
+    - 'identity'.
+    """
+    if kind == "rprelu":
+        mod = RPReLU(name=name)
+        return mod
+    if kind == "hardtanh":
+        return lambda x: jnp.clip(x, -1.0, 1.0)
+    if kind == "identity":
+        return lambda x: x
+    raise ValueError(f"unknown activation kind: {kind!r}")
+
+
+class BiBasicBlock(nn.Module):
+    """Two 3x3 binary residual units with torch-compatible module names.
+
+    Unit 1 (may downsample): ``y = act(BN(conv1(x)) + shortcut(x))``
+    Unit 2:                  ``z = act(BN(conv2(y)) + y)``
+
+    The downsample path (when stride > 1 or channels change) is
+    AvgPool(2) + 1x1 conv (binary for binary variants, strided FP conv
+    for the float teacher) + BN, named ``downsample_conv`` /
+    ``downsample_bn`` so it sorts after ``conv2`` like torch's
+    ``downsample.0``.
+    """
+
+    features: int
+    strides: int = 1
+    variant: str = "react"  # react | step2 | cifar | float
+    act: str = "rprelu"
+
+    @nn.compact
+    def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
+        conv_cls = _CONV_CLASSES[self.variant]
+        in_features = x.shape[-1]
+        needs_ds = self.strides != 1 or in_features != self.features
+
+        # -- shortcut for unit 1
+        if needs_ds:
+            if self.variant == "float":
+                shortcut = FloatConv(
+                    self.features,
+                    kernel_size=(1, 1),
+                    strides=(self.strides, self.strides),
+                    name="downsample_conv",
+                )(x)
+            else:
+                pooled = nn.avg_pool(
+                    x,
+                    window_shape=(self.strides, self.strides),
+                    strides=(self.strides, self.strides),
+                )
+                shortcut = conv_cls(
+                    self.features,
+                    kernel_size=(1, 1),
+                    strides=(1, 1),
+                    name="downsample_conv",
+                )(pooled, tk=tk)
+            shortcut = _batch_norm(train, "downsample_bn")(shortcut)
+        else:
+            shortcut = x
+
+        # -- unit 1
+        y = conv_cls(
+            self.features,
+            kernel_size=(3, 3),
+            strides=(self.strides, self.strides),
+            name="conv1",
+        )(x, tk=tk)
+        y = _batch_norm(train, "bn1")(y)
+        y = y + shortcut
+        y = _activation(self.act, "act1")(y)
+
+        # -- unit 2 (identity shortcut)
+        z = conv_cls(
+            self.features, kernel_size=(3, 3), strides=(1, 1), name="conv2"
+        )(y, tk=tk)
+        z = _batch_norm(train, "bn2")(z)
+        z = z + y
+        z = _activation(self.act, "act2")(z)
+        return z
+
+
+class BiResNet(nn.Module):
+    """Generic basic-block ResNet over binary or float conv variants.
+
+    ``stage_sizes`` blocks per stage; channel widths double per stage
+    from ``width``. ``stem='imagenet'`` is the 7x7/2 + maxpool stem,
+    ``stem='cifar'`` the 3x3/1 stem. The stem conv and the final
+    classifier stay full-precision in every variant — the universal BNN
+    convention (first/last layers carry too much information to
+    binarize; also why the kurtosis selector skips conv #0).
+    """
+
+    stage_sizes: Sequence[int]
+    num_classes: int
+    width: int = 64
+    stem: str = "imagenet"  # imagenet | cifar
+    variant: str = "react"  # react | step2 | cifar | float
+    act: str = "rprelu"  # rprelu | hardtanh | identity
+
+    @nn.compact
+    def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
+        if self.stem == "imagenet":
+            x = FloatConv(
+                self.width, kernel_size=(7, 7), strides=(2, 2), name="conv1"
+            )(x)
+            x = _batch_norm(train, "bn1")(x)
+            x = nn.relu(x)
+            # torch MaxPool2d(3, stride=2, padding=1)
+            x = jnp.pad(
+                x,
+                ((0, 0), (1, 1), (1, 1), (0, 0)),
+                constant_values=-jnp.inf,
+            )
+            x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+        elif self.stem == "cifar":
+            x = FloatConv(
+                self.width, kernel_size=(3, 3), strides=(1, 1), name="conv1"
+            )(x)
+            x = _batch_norm(train, "bn1")(x)
+            x = nn.relu(x)
+        else:
+            raise ValueError(f"unknown stem: {self.stem!r}")
+
+        for s, num_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2**s)
+            for b in range(num_blocks):
+                strides = 2 if (s > 0 and b == 0) else 1
+                x = BiBasicBlock(
+                    features=features,
+                    strides=strides,
+                    variant=self.variant,
+                    act=self.act,
+                    name=f"layer{s + 1}_{b}",
+                )(x, train=train, tk=tk)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, name="fc")(x)
+        return x
+
+
+class VGGSmallBinary(nn.Module):
+    """Binary VGG-Small (the classic XNOR-Net/BNN CIFAR baseline:
+    6 convs 128-128-256-256-512-512 + FC), plain-STE CIFAR variant.
+    First conv full-precision as usual."""
+
+    num_classes: int = 10
+    variant: str = "cifar"
+
+    @nn.compact
+    def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
+        conv_cls = _CONV_CLASSES[self.variant]
+        widths = (128, 128, 256, 256, 512, 512)
+        for i, w in enumerate(widths):
+            name = f"conv{i + 1}"
+            if i == 0:
+                x = FloatConv(w, kernel_size=(3, 3), name=name)(x)
+            else:
+                x = conv_cls(w, kernel_size=(3, 3), name=name)(x, tk=tk)
+            x = _batch_norm(train, f"bn{i + 1}")(x)
+            if i % 2 == 1:
+                x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+            x = jnp.clip(x, -1.0, 1.0)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, name="fc")(x)
+        return x
+
+
+# ---------------------------------------------------------------------------
+# Param-tree utilities (conv ordering, weight access)
+# ---------------------------------------------------------------------------
+
+
+def _natural_key(s: str):
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)]
+
+
+def conv_weight_paths(params) -> list:
+    """Ordered paths of all 4-D conv kernels (``float_weight`` or
+    ``weight``) in the model, stem first — the analogue of the
+    reference's ``named_parameters`` conv scan (``train.py:390-400``).
+
+    Paths are tuples of dict keys, e.g. ``('layer1_0', 'conv1',
+    'float_weight')``. Ordering is alphabetical-DFS with natural number
+    ordering, which by construction of the module names reproduces torch
+    conv order: conv1 < conv2 < downsample_conv within a block,
+    stem conv1 < layer1_0 < layer1_1 < ... at the top.
+    """
+    out = []
+
+    def rec(node, prefix):
+        if isinstance(node, jax.Array) or hasattr(node, "ndim"):
+            if prefix[-1] in ("float_weight", "weight") and node.ndim == 4:
+                out.append(tuple(prefix))
+            return
+        for k in sorted(node.keys(), key=_natural_key):
+            rec(node[k], prefix + [k])
+
+    params = params.get("params", params) if isinstance(params, dict) else params
+    rec(params, [])
+    return out
+
+
+def get_by_path(params, path):
+    node = params.get("params", params) if isinstance(params, dict) else params
+    for k in path:
+        node = node[k]
+    return node
+
+
+def module_path_str(path) -> str:
+    """'layer1_0.conv1' — path string without the trailing param name,
+    used for student/teacher pair matching and hook selection."""
+    return ".".join(path[:-1])

@@ -1,0 +1,117 @@
+"""Torch checkpoint → JAX pytree ingestion for KD teachers.
+
+The reference loads full-precision teachers from torchvision /
+``DataParallel``-wrapped torch checkpoints whose keys carry a
+``module.`` prefix (reference ``train.py:258-277``,
+``utils/KD_loss.py:60``). To reproduce its KD configs on TPU we must be
+able to ingest those ``.pth.tar`` state dicts into our float-twin
+models.
+
+Key translation (torchvision basic-block ResNet → ``BiResNet`` float
+variant):
+
+- ``module.`` prefix stripped;
+- ``layer{S}.{B}.conv{i}.weight``     → ``layer{S}_{B}/conv{i}/weight``
+  with OIHW → HWIO transpose;
+- ``layer{S}.{B}.downsample.0.weight``→ ``.../downsample_conv/weight``;
+- ``layer{S}.{B}.downsample.1.*``     → ``.../downsample_bn/*``;
+- BN ``weight``/``bias`` → flax ``scale``/``bias`` (params);
+  ``running_mean``/``running_var`` → batch_stats ``mean``/``var``;
+- ``fc.weight`` (out, in) → transposed flax Dense ``kernel``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def _strip_module(key: str) -> str:
+    return key[len("module.") :] if key.startswith("module.") else key
+
+
+def _translate_key(key: str) -> Tuple[Tuple[str, ...], str]:
+    """torch state_dict key → (flax path, kind) where kind ∈
+    {conv_w, bn_scale, bn_bias, bn_mean, bn_var, fc_kernel, fc_bias,
+    skip}."""
+    key = _strip_module(key)
+    parts = key.split(".")
+
+    # layerS.B.rest → layerS_B.rest
+    if parts[0].startswith("layer") and len(parts) > 2 and parts[1].isdigit():
+        parts = [f"{parts[0]}_{parts[1]}"] + parts[2:]
+
+    # downsample.0 → downsample_conv, downsample.1 → downsample_bn
+    if "downsample" in parts:
+        i = parts.index("downsample")
+        sub = parts[i + 1]
+        parts = parts[:i] + [
+            "downsample_conv" if sub == "0" else "downsample_bn"
+        ] + parts[i + 2 :]
+
+    leaf = parts[-1]
+    mod = parts[:-1]
+
+    if leaf == "num_batches_tracked":
+        return tuple(mod), "skip"
+    if mod and mod[-1] == "fc":
+        return tuple(mod), "fc_kernel" if leaf == "weight" else "fc_bias"
+    if leaf in ("running_mean", "running_var"):
+        return tuple(mod), "bn_mean" if leaf == "running_mean" else "bn_var"
+    if leaf == "weight":
+        return tuple(mod), "bn_scale" if _is_bn(mod) else "conv_w"
+    if leaf == "bias":
+        return tuple(mod), "bn_bias" if _is_bn(mod) else "conv_bias"
+    return tuple(mod), "skip"
+
+
+def _is_bn(mod_path) -> bool:
+    return bool(mod_path) and ("bn" in mod_path[-1])
+
+
+def _set(tree: Dict, path, value) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def convert_torch_state_dict(state_dict) -> Dict[str, Dict]:
+    """torch ``state_dict`` (tensors or ndarrays) → flax variables dict
+    ``{'params': ..., 'batch_stats': ...}`` for the float-twin models."""
+    params: Dict = {}
+    batch_stats: Dict = {}
+    for key, val in state_dict.items():
+        arr = np.asarray(val.detach().cpu().numpy() if hasattr(val, "detach") else val)
+        mod, kind = _translate_key(key)
+        if kind == "skip":
+            continue
+        if kind == "conv_w":
+            _set(params, (*mod, "weight"), arr.transpose(2, 3, 1, 0))  # OIHW→HWIO
+        elif kind == "conv_bias":
+            _set(params, (*mod, "bias"), arr)
+        elif kind == "bn_scale":
+            _set(params, (*mod, "scale"), arr)
+        elif kind == "bn_bias":
+            _set(params, (*mod, "bias"), arr)
+        elif kind == "bn_mean":
+            _set(batch_stats, (*mod, "mean"), arr)
+        elif kind == "bn_var":
+            _set(batch_stats, (*mod, "var"), arr)
+        elif kind == "fc_kernel":
+            _set(params, (*mod, "kernel"), arr.T)  # (out,in) → (in,out)
+        elif kind == "fc_bias":
+            _set(params, (*mod, "bias"), arr)
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def load_torch_checkpoint(path: str) -> Dict[str, Dict]:
+    """Load a reference-format ``.pth.tar`` checkpoint (dict with a
+    ``state_dict`` entry, reference ``train.py:265-269``) or a bare
+    state dict, and convert it. Requires the baked-in CPU torch."""
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    state_dict = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else ckpt
+    return convert_torch_state_dict(state_dict)

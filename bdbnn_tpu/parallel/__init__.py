@@ -1,1 +1,33 @@
+from bdbnn_tpu.parallel import mesh
+from bdbnn_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    batch_spec,
+    create_sharded_state,
+    initialize_distributed,
+    jit_train_step,
+    make_mesh,
+    param_spec,
+    params_shardings,
+    replicated,
+    shard_batch,
+    shard_variables,
+)
 
+__all__ = [
+    "mesh",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharding",
+    "batch_spec",
+    "create_sharded_state",
+    "initialize_distributed",
+    "jit_train_step",
+    "make_mesh",
+    "param_spec",
+    "params_shardings",
+    "replicated",
+    "shard_batch",
+    "shard_variables",
+]

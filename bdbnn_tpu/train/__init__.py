@@ -1,1 +1,33 @@
+from bdbnn_tpu.train import ede, optim, state, step
+from bdbnn_tpu.train.ede import cpt_tk
+from bdbnn_tpu.train.optim import (
+    conv_weight_mask,
+    cosine_epoch_schedule,
+    linear_epoch_schedule,
+    make_optimizer,
+)
+from bdbnn_tpu.train.state import StepConfig, TrainState
+from bdbnn_tpu.train.step import (
+    make_eval_step,
+    make_train_step,
+    make_ts_train_step,
+    topk_correct,
+)
 
+__all__ = [
+    "ede",
+    "optim",
+    "state",
+    "step",
+    "cpt_tk",
+    "conv_weight_mask",
+    "cosine_epoch_schedule",
+    "linear_epoch_schedule",
+    "make_optimizer",
+    "StepConfig",
+    "TrainState",
+    "make_eval_step",
+    "make_train_step",
+    "make_ts_train_step",
+    "topk_correct",
+]

@@ -1,0 +1,29 @@
+"""EDE (error decay estimator) annealing schedule.
+
+Parity with reference ``utils/utils.py:6-14``:
+
+    t(e) = 10 ** (log10(1e-2) + (log10(1e1) - log10(1e-2)) / E * e)
+    k(e) = max(1 / t(e), 1)
+
+i.e. ``t`` sweeps 1e-2 → 1e1 log-linearly over ``tot_epochs`` and ``k``
+compensates early-training attenuation. The reference pushes (t, k)
+onto every ``nn.Conv2d`` as module attributes each epoch
+(``train.py:409-415``), forcing autograd to read module state; here
+they are plain scalars passed as *traced arguments* into the jitted
+step, so the annealing never retraces or recompiles.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+T_MIN = 1e-2
+T_MAX = 1e1
+
+
+def cpt_tk(epoch: int, tot_epochs: int) -> Tuple[float, float]:
+    lo, hi = math.log10(T_MIN), math.log10(T_MAX)
+    t = 10.0 ** (lo + (hi - lo) / tot_epochs * epoch)
+    k = max(1.0 / t, 1.0)
+    return t, k

@@ -1,0 +1,96 @@
+"""Optimizer / LR-schedule policy (optax), reference-parity.
+
+Reference policy (``train.py:316-336``):
+
+- CIFAR: SGD(lr, momentum .9, weight-decay on ALL params) +
+  ``CosineAnnealingLR(T_max=epochs, eta_min=0)`` stepped per epoch;
+- ImageNet: Adam with weight decay applied ONLY to the "weight
+  parameters" (``p.ndimension() == 4 or 'conv' in pname``,
+  ``train.py:326-331``) + ``LambdaLR`` linear decay
+  ``1 − epoch/epochs`` stepped per epoch.
+
+Torch-parity notes:
+
+- torch SGD/Adam weight decay is the *additive-to-gradient* (L2) form,
+  not AdamW's decoupled form → ``optax.add_decayed_weights`` is chained
+  BEFORE the momentum / Adam transform;
+- torch schedulers step per **epoch** (``train.py:423``), so schedules
+  here are step functions of ``step // steps_per_epoch`` — piecewise-
+  constant within an epoch, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import optax
+from flax import traverse_util
+
+
+def conv_weight_mask(params) -> dict:
+    """Pytree of bools marking the reference's Adam weight-decay group:
+    4-D kernels or any param whose dotted path contains 'conv'
+    (↔ ``train.py:326-329``)."""
+    flat = traverse_util.flatten_dict(params)
+    mask = {
+        k: (v.ndim == 4 or any("conv" in part for part in k))
+        for k, v in flat.items()
+    }
+    return traverse_util.unflatten_dict(mask)
+
+
+def cosine_epoch_schedule(
+    base_lr: float, epochs: int, steps_per_epoch: int, eta_min: float = 0.0
+) -> Callable:
+    """torch CosineAnnealingLR(T_max=epochs) stepped per epoch."""
+
+    def schedule(step):
+        epoch = step // steps_per_epoch
+        return eta_min + (base_lr - eta_min) * 0.5 * (
+            1.0 + jax.numpy.cos(math.pi * epoch / epochs)
+        )
+
+    return schedule
+
+
+def linear_epoch_schedule(
+    base_lr: float, epochs: int, steps_per_epoch: int
+) -> Callable:
+    """torch LambdaLR(lambda e: 1 - e/epochs) stepped per epoch."""
+
+    def schedule(step):
+        epoch = step // steps_per_epoch
+        return base_lr * (1.0 - epoch / epochs)
+
+    return schedule
+
+
+def make_optimizer(
+    params,
+    *,
+    dataset: str,
+    lr: float,
+    epochs: int,
+    steps_per_epoch: int,
+    momentum: float = 0.9,
+    weight_decay: float = 1e-4,
+) -> optax.GradientTransformation:
+    """The full reference policy keyed on dataset (``train.py:316-336``)."""
+    if dataset == "imagenet":
+        schedule = linear_epoch_schedule(lr, epochs, steps_per_epoch)
+        return optax.chain(
+            optax.masked(
+                optax.add_decayed_weights(weight_decay),
+                conv_weight_mask(params),
+            ),
+            optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8),
+            optax.scale_by_learning_rate(schedule),
+        )
+    schedule = cosine_epoch_schedule(lr, epochs, steps_per_epoch)
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.trace(decay=momentum, nesterov=False),
+        optax.scale_by_learning_rate(schedule),
+    )

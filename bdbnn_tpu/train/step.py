@@ -1,0 +1,223 @@
+"""Jitted train / eval / teacher-student steps.
+
+TPU-first redesign of the reference's batch loops (``train.py:441-554``
+plain, ``train.py:556-675`` teacher-student, ``train.py:677-714``
+validation): everything inside a step — forward, all loss terms,
+backward, optimizer update, metrics — is one pure function compiled
+once by XLA. The reference's per-batch Python work (kurtosis-object
+reconstruction ``train.py:461-484``, O(L²) module pair scans in
+``KD_loss.py:59-66``) happens here once at trace time and fuses into
+the compiled program.
+
+Per-epoch variation enters as traced scalars:
+
+- ``tk``         — EDE (t, k) (↔ module mutation ``train.py:409-415``),
+- ``kurt_gate``  — 1.0 when ``epoch >= kurtepoch`` (↔ ``train.py:497``),
+
+so no retrace ever happens across epochs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bdbnn_tpu.losses.kd import (
+    distribution_loss,
+    layer_weight_kl,
+    softmax_cross_entropy,
+)
+from bdbnn_tpu.losses.kurtosis import (
+    kurtosis_regularization,
+    l2_regularization,
+    weight_to_pm1_regularization,
+)
+from bdbnn_tpu.models.resnet import get_by_path
+from bdbnn_tpu.train.state import StepConfig, TrainState
+
+Array = jax.Array
+Batch = Tuple[Array, Array]  # (images NHWC float32, labels int)
+
+
+def topk_correct(logits: Array, labels: Array, ks=(1, 5)) -> Dict[str, Array]:
+    """Counts of top-k correct predictions (↔ utils.accuracy,
+    reference ``utils/utils.py:72-85``, which returns percentages —
+    counts sum exactly under psum/meters)."""
+    out = {}
+    k_max = max(ks)
+    k_max = min(k_max, logits.shape[-1])
+    _, top = jax.lax.top_k(logits, k_max)
+    hit = top == labels[:, None]
+    for k in ks:
+        kk = min(k, logits.shape[-1])
+        out[f"top{k}"] = jnp.sum(hit[:, :kk])
+    return out
+
+
+def _regularization_terms(params, cfg: StepConfig, kurt_gate: Array):
+    """λ·kurt (+ optional L2 / |W|→±1) over the hooked latent weights."""
+    terms = {}
+    total = jnp.float32(0.0)
+    if cfg.w_kurtosis and cfg.kurt_paths:
+        weights = [get_by_path(params, p) for p in cfg.kurt_paths]
+        kurt = kurtosis_regularization(
+            weights, cfg.kurt_targets, cfg.kurtosis_mode
+        )
+        kurt = cfg.w_lambda_kurtosis * kurt * kurt_gate
+        terms["loss_kurt"] = kurt
+        total = total + kurt
+        if cfg.w_l2_reg:
+            l2 = cfg.w_lambda_l2 * l2_regularization(weights)
+            terms["loss_l2"] = l2
+            total = total + l2
+        if cfg.w_wr_reg:
+            wr = cfg.w_lambda_wr * weight_to_pm1_regularization(weights)
+            terms["loss_wr"] = wr
+            total = total + wr
+    return total, terms
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    cfg: StepConfig,
+) -> Callable:
+    """Plain train step: loss = CE + λ·kurt [+ L2 + WR]
+    (↔ reference ``train()``, ``train.py:441-554``)."""
+    cfg = cfg.resolved()
+
+    def train_step(state: TrainState, batch: Batch, tk: Array, kurt_gate: Array):
+        images, labels = batch
+
+        def loss_fn(params):
+            kwargs = {"tk": tk} if cfg.ede else {}
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+                **kwargs,
+            )
+            ce = softmax_cross_entropy(logits, labels)
+            reg, terms = _regularization_terms(params, cfg, kurt_gate)
+            loss = ce + reg
+            aux = {"loss": loss, "loss_ce": ce, **terms, "logits": logits}
+            return loss, (mutated["batch_stats"], aux)
+
+        grads, (new_bs, aux) = jax.grad(loss_fn, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        logits = aux.pop("logits")
+        metrics = {
+            **aux,
+            **topk_correct(logits, labels),
+            "count": jnp.int32(labels.shape[0]),
+        }
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_bs,
+            opt_state=new_opt,
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def make_ts_train_step(
+    model,
+    teacher_model,
+    tx: optax.GradientTransformation,
+    cfg: StepConfig,
+) -> Callable:
+    """Teacher–student step: loss = β·layerKL + α·logitKL +
+    w_lambda_ce·CE + λ·kurt (↔ ``train_teacher_student()``,
+    ``train.py:556-675``; react mode zeroes β and CE,
+    ``train.py:605-609``)."""
+    cfg = cfg.resolved()
+
+    def ts_train_step(
+        state: TrainState,
+        teacher_variables,
+        batch: Batch,
+        tk: Array,
+        kurt_gate: Array,
+    ):
+        images, labels = batch
+
+        def loss_fn(params):
+            kwargs = {"tk": tk} if cfg.ede else {}
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+                **kwargs,
+            )
+            # frozen teacher: eval mode, no grads (↔ requires_grad=False
+            # + .eval(), reference train.py:275-277)
+            t_logits = teacher_model.apply(
+                teacher_variables, images, train=False
+            )
+            t_logits = jax.lax.stop_gradient(t_logits)
+
+            ce = softmax_cross_entropy(logits, labels) * cfg.w_lambda_ce
+            kl_c = distribution_loss(logits, t_logits) * cfg.alpha
+            if cfg.beta != 0.0 and cfg.kd_pairs:
+                sw = [get_by_path(params, sp) for sp, _ in cfg.kd_pairs]
+                tw = [
+                    get_by_path(teacher_variables["params"], tp)
+                    for _, tp in cfg.kd_pairs
+                ]
+                kl_layer = layer_weight_kl(sw, tw) * cfg.beta
+            else:
+                kl_layer = jnp.float32(0.0)
+            reg, terms = _regularization_terms(params, cfg, kurt_gate)
+            loss = kl_layer + kl_c + ce + reg
+            aux = {
+                "loss": loss,
+                "loss_ce": ce,
+                "loss_kl": kl_layer,
+                "loss_kl_c": kl_c,
+                **terms,
+                "logits": logits,
+            }
+            return loss, (mutated["batch_stats"], aux)
+
+        grads, (new_bs, aux) = jax.grad(loss_fn, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        logits = aux.pop("logits")
+        metrics = {
+            **aux,
+            **topk_correct(logits, labels),
+            "count": jnp.int32(labels.shape[0]),
+        }
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_bs,
+            opt_state=new_opt,
+        )
+        return new_state, metrics
+
+    return ts_train_step
+
+
+def make_eval_step(model) -> Callable:
+    """Validation step (↔ ``validate()``, ``train.py:677-714``)."""
+
+    def eval_step(state: TrainState, batch: Batch):
+        images, labels = batch
+        logits = model.apply(state.variables, images, train=False)
+        ce = softmax_cross_entropy(logits, labels)
+        return {
+            "loss": ce,
+            **topk_correct(logits, labels),
+            "count": jnp.int32(labels.shape[0]),
+        }
+
+    return eval_step

@@ -1,1 +1,23 @@
+from bdbnn_tpu.utils import checkpoint, logging_utils, meters
+from bdbnn_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from bdbnn_tpu.utils.logging_utils import (
+    ScalarWriter,
+    make_log_dir,
+    setup_logger,
+)
+from bdbnn_tpu.utils.meters import AverageMeter, ProgressMeter, Timer, format_eta
 
+__all__ = [
+    "checkpoint",
+    "logging_utils",
+    "meters",
+    "load_checkpoint",
+    "save_checkpoint",
+    "ScalarWriter",
+    "make_log_dir",
+    "setup_logger",
+    "AverageMeter",
+    "ProgressMeter",
+    "Timer",
+    "format_eta",
+]

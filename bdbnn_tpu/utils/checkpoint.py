@@ -1,0 +1,99 @@
+"""Checkpoint save/restore (Orbax) — ↔ reference ``utils/utils.py:21-25``
++ ``train.py:345-366, 431-439``.
+
+Layout mirrors the reference's: ``<log_path>/checkpoint`` written every
+epoch, plus ``<log_path>/model_best`` refreshed whenever validation
+top-1 improves. The payload carries ``{epoch, arch, state, best_acc1}``
+(the optimizer state lives inside ``state``). ``reset_resume`` restores
+weights only, restarting the schedule (↔ ``--reset_resume``,
+``train.py:355-361``).
+
+Multi-host: only process 0 writes (↔ the reference's rank-0 guard,
+``train.py:431-432``) — with fully-replicated or addressable shardings
+this is safe; Orbax handles the general case.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+CKPT_NAME = "checkpoint"
+BEST_NAME = "model_best"
+
+
+def _checkpointer() -> ocp.PyTreeCheckpointer:
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(
+    save_path: str,
+    state,
+    *,
+    epoch: int,
+    arch: str,
+    best_acc1: float,
+    is_best: bool,
+) -> None:
+    """Write ``checkpoint`` (and copy to ``model_best`` when best)."""
+    if jax.process_index() != 0:
+        return
+    payload = {
+        "epoch": epoch + 1,
+        "arch": arch,
+        "best_acc1": float(best_acc1),
+        "state": jax.device_get(state),
+    }
+    os.makedirs(save_path, exist_ok=True)
+    target = os.path.join(save_path, CKPT_NAME)
+    if os.path.exists(target):
+        shutil.rmtree(target)
+    _checkpointer().save(target, payload)
+    if is_best:
+        best = os.path.join(save_path, BEST_NAME)
+        if os.path.exists(best):
+            shutil.rmtree(best)
+        shutil.copytree(target, best)
+
+
+def load_checkpoint(
+    path: str,
+    state_template,
+    *,
+    reset_resume: bool = False,
+) -> Dict[str, Any]:
+    """Restore a checkpoint against a template state.
+
+    Returns ``{epoch, arch, best_acc1, state}``. With ``reset_resume``
+    the returned epoch/best are zeroed and only weights (params +
+    batch_stats) are taken from the checkpoint — the optimizer state
+    and schedule restart (↔ ``--reset_resume``)."""
+    if os.path.isdir(path) and os.path.isdir(os.path.join(path, CKPT_NAME)):
+        path = os.path.join(path, CKPT_NAME)
+    template = {
+        "epoch": 0,
+        "arch": "",
+        "best_acc1": 0.0,
+        "state": jax.device_get(state_template),
+    }
+    payload = _checkpointer().restore(path, item=template)
+    state = state_template.replace(
+        params=payload["state"]["params"],
+        batch_stats=payload["state"]["batch_stats"],
+    )
+    if reset_resume:
+        return {"epoch": 0, "arch": payload["arch"], "best_acc1": 0.0, "state": state}
+    state = state.replace(
+        step=payload["state"]["step"],
+        opt_state=payload["state"]["opt_state"],
+    )
+    return {
+        "epoch": int(payload["epoch"]),
+        "arch": payload["arch"],
+        "best_acc1": float(payload["best_acc1"]),
+        "state": state,
+    }

@@ -1,0 +1,96 @@
+"""Meters / progress display / ETA — parity with reference
+``utils/utils.py:27-69`` and the ETA printer at ``train.py:538-550``."""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, List, Optional
+
+
+class AverageMeter:
+    """Running value/avg/sum/count meter (↔ utils/utils.py:27-47)."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def get_avg(self) -> float:
+        return self.avg
+
+    def __str__(self) -> str:
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(name=self.name, val=self.val, avg=self.avg)
+
+
+class ProgressMeter:
+    """Formatted per-batch progress lines (↔ utils/utils.py:50-69)."""
+
+    def __init__(
+        self,
+        num_batches: int,
+        meters: Iterable[AverageMeter],
+        logger=None,
+        prefix: str = "",
+    ):
+        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
+        self.meters = list(meters)
+        self.logger = logger
+        self.prefix = prefix
+
+    def display(self, batch: int) -> str:
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(m) for m in self.meters]
+        line = "\t".join(entries)
+        if self.logger is not None:
+            self.logger.info(line)
+        return line
+
+    @staticmethod
+    def _get_batch_fmtstr(num_batches: int) -> str:
+        num_digits = len(str(num_batches // 1))
+        fmt = "{:" + str(num_digits) + "d}"
+        return "[" + fmt + "/" + fmt.format(num_batches) + "]"
+
+
+def format_eta(remain_seconds: float) -> str:
+    """Remaining-time string (↔ train.py:541-550)."""
+    seconds = (remain_seconds // 1) % 60
+    minutes = (remain_seconds // 60) % 60
+    hours = (remain_seconds // 3600) % 24
+    days = remain_seconds // 86400
+    out = ""
+    if days > 0:
+        out += f"{int(days)} days, "
+    if hours > 0:
+        out += f"{int(hours)} hr, "
+    if minutes > 0:
+        out += f"{int(minutes)} min, "
+    if seconds > 0:
+        out += f"{int(seconds)} sec, "
+    return out
+
+
+class Timer:
+    """Batch/data-time tracking helper around the meters."""
+
+    def __init__(self):
+        self.end = time.time()
+
+    def lap(self) -> float:
+        now = time.time()
+        dt = now - self.end
+        self.end = now
+        return dt

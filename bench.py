@@ -1,0 +1,100 @@
+"""Benchmark harness: flagship train-step throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: images/sec/chip for the full BD-BNN training step (forward +
+backward + optimizer + kurtosis regularization) on binary ResNet-18 at
+224×224 — the workload of BASELINE config 3 ("ResNet-18 BD-BNN,
+ImageNet, single-chip, kurtosis reg only").
+
+vs_baseline normalizes against the reference's GPU throughput for the
+same step. The reference repo publishes no numbers (SURVEY.md §6), so
+the anchor is an estimate pinned here: ~900 images/sec on a modern
+training GPU for ReActNet-style binary ResNet-18 with FP32 master
+weights (binary nets run at FP speed on GPUs — cuDNN has no 1-bit
+path, matching the reference's stock-PyTorch convs). The BASELINE.json
+north star asks for ≥1.5× chip-normalized.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 900.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bdbnn_tpu.models import conv_weight_paths, create_model
+    from bdbnn_tpu.train import (
+        StepConfig,
+        TrainState,
+        make_optimizer,
+        make_train_step,
+    )
+
+    batch = 64
+    model = create_model("resnet18", "imagenet")
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(batch, 224, 224, 3)),
+        jnp.float32,
+    )
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 1000, size=(batch,)))
+
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)), train=True
+    )
+    paths = conv_weight_paths(variables["params"])
+    hooked = tuple(paths[1:])
+    cfg = StepConfig(
+        w_kurtosis=True,
+        kurt_paths=hooked,
+        kurt_targets=(1.8,) * len(hooked),
+        kurtosis_mode="avg",
+        w_lambda_kurtosis=1.0,
+    )
+    tx = make_optimizer(
+        variables["params"], dataset="imagenet", lr=1e-3,
+        epochs=90, steps_per_epoch=1000,
+    )
+    state = TrainState.create(variables, tx)
+    step = jax.jit(make_train_step(model, tx, cfg), donate_argnums=(0,))
+
+    tk = (jnp.float32(1.0), jnp.float32(1.0))
+    gate = jnp.float32(1.0)
+
+    # warmup / compile
+    state, metrics = step(state, (x, y), tk, gate)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, (x, y), tk, gate)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    n_chips = max(jax.device_count(), 1)
+    per_chip = images_per_sec / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_step_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

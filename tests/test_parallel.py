@@ -1,0 +1,162 @@
+"""Distributed tests on the 8-device CPU-simulated mesh — the
+JAX-native analogue of a mock-NCCL DDP test (SURVEY.md §4).
+
+Key property: a DP-sharded train step must be numerically equivalent to
+the same step on one device with the same global batch (DDP's gradient
+all-reduce == jit's psum insertion)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bdbnn_tpu.models.resnet import BiResNet
+from bdbnn_tpu.parallel import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    create_sharded_state,
+    jit_train_step,
+    make_mesh,
+    params_shardings,
+    shard_batch,
+    shard_variables,
+)
+from bdbnn_tpu.train import StepConfig, TrainState, make_optimizer, make_train_step
+
+
+def _model():
+    return BiResNet(
+        stage_sizes=(1, 1), num_classes=4, width=8,
+        stem="cifar", variant="cifar", act="hardtanh",
+    )
+
+
+def _batch(n=16, hw=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=(n,)).astype(np.int64)
+    return x, y
+
+
+def test_eight_cpu_devices_available():
+    assert jax.device_count() == 8
+
+
+class TestMesh:
+    def test_pure_dp_mesh_shape(self):
+        mesh = make_mesh()
+        assert mesh.shape[DATA_AXIS] == 8
+        assert mesh.shape[MODEL_AXIS] == 1
+
+    def test_2d_mesh(self):
+        mesh = make_mesh(model_parallel=2)
+        assert mesh.shape[DATA_AXIS] == 4
+        assert mesh.shape[MODEL_AXIS] == 2
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            make_mesh(model_parallel=3)
+
+    def test_param_shardings_pure_dp_replicated(self):
+        mesh = make_mesh()
+        model = _model()
+        v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=False)
+        sh = params_shardings(mesh, v["params"])
+        for s in jax.tree_util.tree_leaves(
+            sh, is_leaf=lambda x: hasattr(x, "spec")
+        ):
+            assert all(a is None for a in s.spec)
+
+    def test_model_axis_shards_large_kernels(self):
+        mesh = make_mesh(model_parallel=2)
+        params = {
+            "big": {"float_weight": jnp.zeros((3, 3, 256, 512))},
+            "small": {"float_weight": jnp.zeros((3, 3, 8, 8))},
+            "bn": {"scale": jnp.zeros((512,))},
+        }
+        sh = params_shardings(mesh, params)
+        assert sh["big"]["float_weight"].spec[-1] == MODEL_AXIS
+        assert all(a is None for a in sh["small"]["float_weight"].spec)
+        assert all(a is None for a in sh["bn"]["scale"].spec)
+
+
+class TestDPEquivalence:
+    def _run_single(self, model, variables, batch, steps=3):
+        tx = make_optimizer(
+            variables["params"], dataset="cifar10", lr=0.05,
+            epochs=10, steps_per_epoch=100,
+        )
+        state = TrainState.create(variables, tx)
+        step = jax.jit(make_train_step(model, tx, StepConfig()))
+        tk = jnp.float32(1.0), jnp.float32(1.0)
+        x, y = batch
+        metrics = None
+        for _ in range(steps):
+            state, metrics = step(
+                state, (jnp.asarray(x), jnp.asarray(y)), tk, jnp.float32(0.0)
+            )
+        return state, metrics
+
+    def _run_sharded(self, model, variables, batch, steps=3, model_parallel=1):
+        mesh = make_mesh(model_parallel=model_parallel)
+        tx = make_optimizer(
+            variables["params"], dataset="cifar10", lr=0.05,
+            epochs=10, steps_per_epoch=100,
+        )
+        state = create_sharded_state(mesh, variables, tx, TrainState)
+        step = jit_train_step(make_train_step(model, tx, StepConfig()))
+        tk = jnp.float32(1.0), jnp.float32(1.0)
+        x, y = batch
+        metrics = None
+        for _ in range(steps):
+            gx, gy = shard_batch(mesh, x, y)
+            state, metrics = step(state, (gx, gy), tk, jnp.float32(0.0))
+        return state, metrics
+
+    def test_dp_equals_single_device(self):
+        model = _model()
+        batch = _batch(n=16)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=True
+        )
+        s_single, m_single = self._run_single(model, variables, batch)
+        s_dp, m_dp = self._run_sharded(model, variables, batch)
+        assert float(m_single["loss"]) == pytest.approx(
+            float(m_dp["loss"]), rel=2e-4
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s_single.params),
+            jax.tree_util.tree_leaves(s_dp.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4
+            )
+
+    def test_dp_plus_tp_equals_single_device(self):
+        model = _model()
+        batch = _batch(n=16, seed=4)
+        variables = model.init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 8, 8, 3)), train=True
+        )
+        s_single, m_single = self._run_single(model, variables, batch)
+        s_tp, m_tp = self._run_sharded(model, variables, batch, model_parallel=2)
+        assert float(m_single["loss"]) == pytest.approx(
+            float(m_tp["loss"]), rel=2e-4
+        )
+
+    def test_batch_is_actually_sharded(self):
+        mesh = make_mesh()
+        x, y = _batch(n=16)
+        gx, gy = shard_batch(mesh, x, y)
+        assert gx.sharding.is_equivalent_to(batch_sharding(mesh, 4), 4)
+        # each device holds 1/8 of the batch
+        assert gx.addressable_shards[0].data.shape[0] == 2
+
+    def test_sharded_variables_replicated(self):
+        mesh = make_mesh()
+        model = _model()
+        v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=True)
+        placed = shard_variables(mesh, v)
+        leaf = jax.tree_util.tree_leaves(placed["params"])[0]
+        assert len(leaf.sharding.device_set) == 8

@@ -1,0 +1,339 @@
+"""Train-core tests: EDE schedule parity, optimizer parity vs torch,
+train-step behavior (loss decreases, kurtosis gating, TS loss wiring)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bdbnn_tpu.losses.kd import softmax_cross_entropy
+from bdbnn_tpu.models import conv_weight_paths, module_path_str
+from bdbnn_tpu.models.resnet import BiResNet
+from bdbnn_tpu.train import (
+    StepConfig,
+    TrainState,
+    cpt_tk,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    make_ts_train_step,
+)
+from bdbnn_tpu.train.optim import conv_weight_mask
+
+
+class TestEDESchedule:
+    def test_matches_reference_formula(self):
+        # oracle: utils/utils.py:6-14 computed with torch
+        import torch
+
+        for epoch, tot in [(0, 90), (45, 90), (89, 90), (10, 200)]:
+            T_min, T_max = torch.tensor(1e-2).float(), torch.tensor(1e1).float()
+            Tmin, Tmax = torch.log10(T_min), torch.log10(T_max)
+            t_ref = torch.pow(
+                torch.tensor(10.0), Tmin + (Tmax - Tmin) / tot * epoch
+            ).item()
+            k_ref = max(1.0 / t_ref, 1.0)
+            t, k = cpt_tk(epoch, tot)
+            assert t == pytest.approx(t_ref, rel=1e-5)
+            assert k == pytest.approx(k_ref, rel=1e-5)
+
+    def test_endpoints(self):
+        t0, k0 = cpt_tk(0, 100)
+        assert t0 == pytest.approx(1e-2)
+        assert k0 == pytest.approx(100.0)
+        t_end, k_end = cpt_tk(100, 100)
+        assert t_end == pytest.approx(10.0)
+        assert k_end == 1.0
+
+
+def _tiny_model():
+    return BiResNet(
+        stage_sizes=(1, 1),
+        num_classes=4,
+        width=8,
+        stem="cifar",
+        variant="cifar",
+        act="hardtanh",
+    )
+
+
+def _tiny_batch(rng, n=16, hw=8, classes=4):
+    x = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    y = rng.integers(0, classes, size=(n,))
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+class TestOptimizerParity:
+    def _torch_reference(self, params_np, grads_np, kind, steps, lr, wd, momentum):
+        import torch
+
+        tparams = [torch.nn.Parameter(torch.tensor(p)) for p in params_np]
+        if kind == "sgd":
+            opt = torch.optim.SGD(
+                tparams, lr=lr, momentum=momentum, weight_decay=wd
+            )
+        else:
+            opt = torch.optim.Adam(
+                [
+                    {"params": [tparams[0]]},  # no wd ("other")
+                    {"params": [tparams[1]], "weight_decay": wd},
+                ],
+                lr=lr,
+            )
+        for _ in range(steps):
+            for p, g in zip(tparams, grads_np):
+                p.grad = torch.tensor(g)
+            opt.step()
+            opt.zero_grad()
+        return [p.detach().numpy() for p in tparams]
+
+    def test_sgd_matches_torch(self, rng):
+        p0 = rng.normal(size=(3, 3)).astype(np.float32)
+        p1 = rng.normal(size=(5,)).astype(np.float32)
+        g0 = rng.normal(size=(3, 3)).astype(np.float32)
+        g1 = rng.normal(size=(5,)).astype(np.float32)
+        params = {"a": jnp.asarray(p0), "b": jnp.asarray(p1)}
+        grads = {"a": jnp.asarray(g0), "b": jnp.asarray(g1)}
+        tx = make_optimizer(
+            params,
+            dataset="cifar10",
+            lr=0.1,
+            epochs=10,
+            steps_per_epoch=1000,  # stay in epoch 0 → constant-lr segment
+            momentum=0.9,
+            weight_decay=1e-4,
+        )
+        opt_state = tx.init(params)
+        import optax
+
+        for _ in range(3):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        # torch cosine epoch-0 multiplier is 1.0 → plain lr
+        ref = self._torch_reference(
+            [p0, p1], [g0, g1], "sgd", 3, 0.1, 1e-4, 0.9
+        )
+        np.testing.assert_allclose(np.asarray(params["a"]), ref[0], atol=1e-5)
+        np.testing.assert_allclose(np.asarray(params["b"]), ref[1], atol=1e-5)
+
+    def test_adam_masked_wd_matches_torch(self, rng):
+        # param "other" (1-D, not conv) gets NO decay; 4-D conv gets decay
+        p_other = rng.normal(size=(7,)).astype(np.float32)
+        p_conv = rng.normal(size=(3, 3, 2, 4)).astype(np.float32)
+        g_other = rng.normal(size=(7,)).astype(np.float32)
+        g_conv = rng.normal(size=(3, 3, 2, 4)).astype(np.float32)
+        params = {"bn": {"scale": jnp.asarray(p_other)},
+                  "conv1": {"float_weight": jnp.asarray(p_conv)}}
+        grads = {"bn": {"scale": jnp.asarray(g_other)},
+                 "conv1": {"float_weight": jnp.asarray(g_conv)}}
+        tx = make_optimizer(
+            params,
+            dataset="imagenet",
+            lr=1e-3,
+            epochs=10,
+            steps_per_epoch=1000,
+            weight_decay=1e-4,
+        )
+        opt_state = tx.init(params)
+        import optax
+
+        for _ in range(4):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        ref = self._torch_reference(
+            [p_other, p_conv], [g_other, g_conv], "adam", 4, 1e-3, 1e-4, 0.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["bn"]["scale"]), ref[0], atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["conv1"]["float_weight"]), ref[1], atol=1e-6
+        )
+
+    def test_mask_selects_4d_or_conv_named(self, rng):
+        params = {
+            "conv1": {"weight": jnp.zeros((3, 3, 2, 4))},
+            "layer1_0": {
+                "conv2": {"float_weight": jnp.zeros((3, 3, 4, 4))},
+                "bn1": {"scale": jnp.zeros((4,))},
+            },
+            "fc": {"kernel": jnp.zeros((8, 4)), "bias": jnp.zeros((4,))},
+        }
+        mask = conv_weight_mask(params)
+        assert mask["conv1"]["weight"] is True
+        assert mask["layer1_0"]["conv2"]["float_weight"] is True
+        # 'conv' appears in the bn's parent path? No — bn under layer1_0
+        assert mask["layer1_0"]["bn1"]["scale"] is False
+        assert mask["fc"]["kernel"] is False
+        assert mask["fc"]["bias"] is False
+
+
+class TestTrainStep:
+    def _setup(self, cfg=None, seed=0):
+        rng = np.random.default_rng(seed)
+        model = _tiny_model()
+        x, y = _tiny_batch(rng)
+        variables = model.init(jax.random.PRNGKey(seed), x, train=True)
+        tx = make_optimizer(
+            variables["params"],
+            dataset="cifar10",
+            lr=0.05,
+            epochs=10,
+            steps_per_epoch=100,
+        )
+        state = TrainState.create(variables, tx)
+        if cfg is None:
+            cfg = StepConfig()
+        step = jax.jit(make_train_step(model, tx, cfg))
+        return model, state, step, (x, y)
+
+    def test_loss_decreases(self):
+        _, state, step, batch = self._setup()
+        tk = jnp.float32(1.0), jnp.float32(1.0)
+        losses = []
+        for _ in range(25):
+            state, metrics = step(state, batch, tk, jnp.float32(0.0))
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.8, losses[::6]
+        assert np.isfinite(losses).all()
+
+    def test_kurtosis_gate_and_term(self):
+        rng = np.random.default_rng(0)
+        model = _tiny_model()
+        x, y = _tiny_batch(rng)
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        paths = conv_weight_paths(variables["params"])
+        hooked = tuple(paths[1:])
+        cfg = StepConfig(
+            w_kurtosis=True,
+            kurt_paths=hooked,
+            kurt_targets=(1.8,) * len(hooked),
+            kurtosis_mode="avg",
+            w_lambda_kurtosis=1.0,
+        )
+        tx = make_optimizer(
+            variables["params"], dataset="cifar10", lr=0.05,
+            epochs=10, steps_per_epoch=100,
+        )
+        state = TrainState.create(variables, tx)
+        step = jax.jit(make_train_step(model, tx, cfg))
+        tk = jnp.float32(1.0), jnp.float32(1.0)
+        _, m_off = step(state, (x, y), tk, jnp.float32(0.0))
+        _, m_on = step(state, (x, y), tk, jnp.float32(1.0))
+        assert float(m_off["loss_kurt"]) == 0.0
+        assert float(m_on["loss_kurt"]) > 0.0
+        assert float(m_on["loss"]) == pytest.approx(
+            float(m_on["loss_ce"]) + float(m_on["loss_kurt"]), rel=1e-5
+        )
+
+    def test_metrics_counts(self):
+        _, state, step, batch = self._setup()
+        tk = jnp.float32(1.0), jnp.float32(1.0)
+        _, metrics = step(state, batch, tk, jnp.float32(0.0))
+        assert int(metrics["count"]) == 16
+        assert 0 <= int(metrics["top1"]) <= int(metrics["top5"]) <= 16
+
+
+class TestTSStep:
+    def test_react_vs_full_loss_wiring(self):
+        rng = np.random.default_rng(1)
+        student = _tiny_model()
+        teacher = BiResNet(
+            stage_sizes=(1, 1), num_classes=4, width=8,
+            stem="cifar", variant="float", act="identity",
+        )
+        x, y = _tiny_batch(rng)
+        sv = student.init(jax.random.PRNGKey(0), x, train=True)
+        tv = teacher.init(jax.random.PRNGKey(1), x, train=False)
+        s_paths = conv_weight_paths(sv["params"])
+        t_paths = conv_weight_paths(tv["params"])
+        # pair all non-stem convs (name-aligned by construction)
+        pairs = tuple(
+            (sp, tp)
+            for sp, tp in zip(s_paths[1:], t_paths[1:])
+            if "downsample" not in module_path_str(sp)
+        )
+        tx = make_optimizer(
+            sv["params"], dataset="cifar10", lr=0.01,
+            epochs=10, steps_per_epoch=100,
+        )
+        tk = jnp.float32(1.0), jnp.float32(1.0)
+
+        full_cfg = StepConfig(
+            teacher_student=True, react=False, alpha=0.9, beta=2.0,
+            w_lambda_ce=1.0, kd_pairs=pairs,
+        )
+        state = TrainState.create(sv, tx)
+        step_full = jax.jit(make_ts_train_step(student, teacher, tx, full_cfg))
+        _, m_full = step_full(state, tv, (x, y), tk, jnp.float32(0.0))
+        assert float(m_full["loss_kl"]) != 0.0
+        assert float(m_full["loss_ce"]) != 0.0
+        assert float(m_full["loss"]) == pytest.approx(
+            float(m_full["loss_kl"])
+            + float(m_full["loss_kl_c"])
+            + float(m_full["loss_ce"]),
+            rel=1e-4,
+        )
+
+        # react mode: beta = 0, CE weight = 0 (train.py:605-609)
+        react_cfg = StepConfig(
+            teacher_student=True, react=True, alpha=0.9, beta=2.0,
+            w_lambda_ce=1.0, kd_pairs=pairs,
+        )
+        state2 = TrainState.create(sv, tx)
+        step_react = jax.jit(
+            make_ts_train_step(student, teacher, tx, react_cfg)
+        )
+        _, m_react = step_react(state2, tv, (x, y), tk, jnp.float32(0.0))
+        assert float(m_react["loss_kl"]) == 0.0
+        assert float(m_react["loss_ce"]) == 0.0
+        assert float(m_react["loss"]) == pytest.approx(
+            float(m_react["loss_kl_c"]), rel=1e-5
+        )
+
+    def test_teacher_frozen(self):
+        """Gradients must not flow into teacher variables (↔ the
+        reference's requires_grad=False freeze, train.py:275-277)."""
+        rng = np.random.default_rng(2)
+        student = _tiny_model()
+        teacher = BiResNet(
+            stage_sizes=(1, 1), num_classes=4, width=8,
+            stem="cifar", variant="float", act="identity",
+        )
+        x, y = _tiny_batch(rng)
+        sv = student.init(jax.random.PRNGKey(0), x, train=True)
+        tv = teacher.init(jax.random.PRNGKey(1), x, train=False)
+        cfg = StepConfig(teacher_student=True, alpha=1.0, beta=0.0)
+
+        def loss_via_teacher(tparams):
+            t_logits = teacher.apply(
+                {"params": tparams, "batch_stats": tv["batch_stats"]},
+                x, train=False,
+            )
+            logits = student.apply(sv, x, train=False)
+            from bdbnn_tpu.losses.kd import distribution_loss
+
+            return distribution_loss(logits, t_logits)
+
+        g = jax.grad(loss_via_teacher)(tv["params"])
+        total = sum(float(jnp.abs(l).sum()) for l in jax.tree_util.tree_leaves(g))
+        assert total == 0.0
+
+
+class TestEvalStep:
+    def test_eval_matches_manual_ce(self):
+        rng = np.random.default_rng(3)
+        model = _tiny_model()
+        x, y = _tiny_batch(rng)
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        tx = make_optimizer(
+            variables["params"], dataset="cifar10", lr=0.1,
+            epochs=1, steps_per_epoch=1,
+        )
+        state = TrainState.create(variables, tx)
+        ev = jax.jit(make_eval_step(model))
+        metrics = ev(state, (x, y))
+        logits = model.apply(variables, x, train=False)
+        assert float(metrics["loss"]) == pytest.approx(
+            float(softmax_cross_entropy(logits, y)), rel=1e-6
+        )
