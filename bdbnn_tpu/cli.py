@@ -79,6 +79,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--distributed-init", action="store_true",
         help="call jax.distributed.initialize() (multi-host pods)",
     )
+    # TPU-native extras
+    p.add_argument(
+        "--synthetic", action="store_true",
+        help="train on random tensors (smoke/bench only)",
+    )
+    p.add_argument(
+        "--pretrained-path", default="", type=str,
+        help="local torch checkpoint backing --pretrained (no egress)",
+    )
+    p.add_argument(
+        "--dtype", default="float32", choices=["float32", "bfloat16"],
+        help="compute dtype (bf16 doubles MXU throughput; params stay f32)",
+    )
+    p.add_argument(
+        "--profile-dir", default="", type=str,
+        help="write a jax.profiler trace of a few epoch-0 steps here",
+    )
     # legacy GPU/NCCL flags: accepted, ignored
     for flag, kw in [
         ("--world-size", dict(type=int, default=1)),
@@ -152,12 +169,28 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         w_lambda_ce=args.w_lambda_ce,
         model_parallel=args.model_parallel,
         distributed_init=args.distributed_init,
+        synthetic=args.synthetic,
+        pretrained_path=args.pretrained_path,
+        dtype=args.dtype,
+        profile_dir=args.profile_dir,
     )
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
+
+    # An explicit JAX_PLATFORMS env var must win even when a PJRT-plugin
+    # sitecustomize already forced jax_platforms via jax.config.update
+    # (config updates silently shadow the env var; a user asking for
+    # JAX_PLATFORMS=cpu would otherwise block on remote-TPU init).
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     from bdbnn_tpu.train.loop import fit
 
     result = fit(cfg)

@@ -28,10 +28,14 @@ class RunConfig:
     data: str = ""  # dataset dir (positional in the reference)
     dataset: str = "cifar10"  # cifar10 | cifar100 | imagenet
     workers: int = 4
+    synthetic: bool = False  # train on random tensors (smoke/bench only)
+    synthetic_train_size: int = 2048
+    synthetic_val_size: int = 512
     # model
     arch: str = "resnet18"
     custom_resnet: bool = True
     pretrained: bool = False
+    pretrained_path: str = ""  # local torch ckpt backing --pretrained
     twoblock: bool = False  # parsed-but-unused in the reference; kept
     # schedule
     epochs: int = 90
@@ -68,6 +72,10 @@ class RunConfig:
     arch_teacher: str = "resnet18_float"
     custom_resnet_teacher: bool = False
     resume_teacher: str = ""
+    # escape hatch for smoke tests ONLY: a TS run with no teacher
+    # checkpoint otherwise fails loudly (a random-init teacher makes KD
+    # silently meaningless — the reference allowed that, train.py:259)
+    allow_random_teacher: bool = False
     react: bool = False
     alpha: float = 0.9
     temperature: float = 4.0
@@ -78,6 +86,11 @@ class RunConfig:
     distributed_init: bool = False  # call jax.distributed.initialize()
     # compute
     dtype: str = "float32"  # float32 | bfloat16 activations
+    # observability (SURVEY.md §5.1): write a jax.profiler trace for
+    # steps [profile_start, profile_start+profile_steps) of epoch 0
+    profile_dir: str = ""
+    profile_start: int = 5
+    profile_steps: int = 5
 
     @property
     def num_classes(self) -> int:
@@ -94,4 +107,11 @@ class RunConfig:
             raise ValueError(f"unknown kurtosis mode {self.kurtosis_mode!r}")
         if self.batch_size <= 0 or self.epochs <= 0:
             raise ValueError("batch_size and epochs must be positive")
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown dtype {self.dtype!r}")
+        if self.pretrained and not self.pretrained_path:
+            raise ValueError(
+                "--pretrained needs --pretrained-path (no network egress: "
+                "point it at a local torchvision .pth checkpoint)"
+            )
         return self

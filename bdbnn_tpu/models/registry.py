@@ -28,7 +28,21 @@ def _cifar_classes(dataset: str) -> int:
     return {"cifar10": 10, "cifar100": 100}[dataset]
 
 
-def _make_cifar(name, stage_sizes, width, variant, act, num_classes):
+def resolve_dtype(dtype):
+    """'float32' | 'bfloat16' | None | jnp dtype → jnp dtype or None
+    (None ≡ float32 compute; the RunConfig.dtype knob funnels here)."""
+    if dtype is None or dtype == "float32":
+        return None
+    if isinstance(dtype, str):
+        import jax.numpy as jnp
+
+        if dtype == "bfloat16":
+            return jnp.bfloat16
+        raise ValueError(f"unknown dtype {dtype!r}; use float32|bfloat16")
+    return dtype
+
+
+def _make_cifar(name, stage_sizes, width, variant, act, num_classes, dtype=None):
     return BiResNet(
         stage_sizes=stage_sizes,
         num_classes=num_classes,
@@ -36,12 +50,15 @@ def _make_cifar(name, stage_sizes, width, variant, act, num_classes):
         stem="cifar",
         variant=variant,
         act=act,
+        dtype=resolve_dtype(dtype),
     )
 
 
-def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000, pretrained=False):
-    # ``pretrained`` accepted for reference-API parity; weight loading
-    # happens via bdbnn_tpu.models.torch_import (no network egress).
+def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000,
+                   pretrained=False, dtype=None):
+    # ``pretrained`` accepted for reference-API parity (train.py:285-288);
+    # the actual weight loading goes through create_model's caller via
+    # bdbnn_tpu.models.torch_import (no network egress in this image).
     del pretrained
     return BiResNet(
         stage_sizes=stage_sizes,
@@ -50,6 +67,13 @@ def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000, pretrained
         stem="imagenet",
         variant=variant,
         act=act,
+        dtype=resolve_dtype(dtype),
+    )
+
+
+def _make_vgg(num_classes, variant="cifar", dtype=None):
+    return VGGSmallBinary(
+        num_classes=num_classes, variant=variant, dtype=resolve_dtype(dtype)
     )
 
 
@@ -67,7 +91,7 @@ def cifar_model_factories(num_classes: int = 10) -> Dict[str, Callable]:
         "resnet18_float": f(_make_cifar, "resnet18_float", (2, 2, 2, 2), 64, "float", "identity", num_classes),
         "resnet20_float": f(_make_cifar, "resnet20_float", (3, 3, 3), 16, "float", "identity", num_classes),
         "resnet34_float": f(_make_cifar, "resnet34_float", (3, 4, 6, 3), 64, "float", "identity", num_classes),
-        "vgg_small": f(VGGSmallBinary, num_classes),
+        "vgg_small": f(_make_vgg, num_classes),
     }
 
 

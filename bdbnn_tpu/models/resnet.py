@@ -40,7 +40,7 @@ and eps 1e-5 for teacher-checkpoint parity.
 from __future__ import annotations
 
 import re
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -64,12 +64,17 @@ _CONV_CLASSES = {
 }
 
 
-def _batch_norm(train: bool, name: str) -> nn.BatchNorm:
+def _batch_norm(train: bool, name: str, dtype=None) -> nn.BatchNorm:
+    # dtype=bfloat16 keeps outputs in the compute dtype while flax
+    # computes the batch statistics in float32 (force_float32_reductions
+    # default) — the standard TPU mixed-precision recipe: bf16 activations
+    # on the MXU, f32 statistics and master params.
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=1e-5,
         name=name,
+        dtype=dtype,
     )
 
 
@@ -108,35 +113,30 @@ class BiBasicBlock(nn.Module):
     strides: int = 1
     variant: str = "react"  # react | step2 | cifar | float
     act: str = "rprelu"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
+        if self.variant == "float":
+            return self._float_forward(x, train=train)
         conv_cls = _CONV_CLASSES[self.variant]
         in_features = x.shape[-1]
         needs_ds = self.strides != 1 or in_features != self.features
 
         # -- shortcut for unit 1
         if needs_ds:
-            if self.variant == "float":
-                shortcut = FloatConv(
-                    self.features,
-                    kernel_size=(1, 1),
-                    strides=(self.strides, self.strides),
-                    name="downsample_conv",
-                )(x)
-            else:
-                pooled = nn.avg_pool(
-                    x,
-                    window_shape=(self.strides, self.strides),
-                    strides=(self.strides, self.strides),
-                )
-                shortcut = conv_cls(
-                    self.features,
-                    kernel_size=(1, 1),
-                    strides=(1, 1),
-                    name="downsample_conv",
-                )(pooled, tk=tk)
-            shortcut = _batch_norm(train, "downsample_bn")(shortcut)
+            pooled = nn.avg_pool(
+                x,
+                window_shape=(self.strides, self.strides),
+                strides=(self.strides, self.strides),
+            )
+            shortcut = conv_cls(
+                self.features,
+                kernel_size=(1, 1),
+                strides=(1, 1),
+                name="downsample_conv",
+            )(pooled, tk=tk)
+            shortcut = _batch_norm(train, "downsample_bn", self.dtype)(shortcut)
         else:
             shortcut = x
 
@@ -147,7 +147,7 @@ class BiBasicBlock(nn.Module):
             strides=(self.strides, self.strides),
             name="conv1",
         )(x, tk=tk)
-        y = _batch_norm(train, "bn1")(y)
+        y = _batch_norm(train, "bn1", self.dtype)(y)
         y = y + shortcut
         y = _activation(self.act, "act1")(y)
 
@@ -155,10 +155,42 @@ class BiBasicBlock(nn.Module):
         z = conv_cls(
             self.features, kernel_size=(3, 3), strides=(1, 1), name="conv2"
         )(y, tk=tk)
-        z = _batch_norm(train, "bn2")(z)
+        z = _batch_norm(train, "bn2", self.dtype)(z)
         z = z + y
         z = _activation(self.act, "act2")(z)
         return z
+
+    def _float_forward(self, x: Array, *, train: bool) -> Array:
+        """Torch-faithful torchvision BasicBlock forward for the FP
+        teacher twin: relu(bn1(conv1(x))) → bn2(conv2(·)) → add the
+        BLOCK INPUT (strided-1x1-conv downsample when shapes change) →
+        relu. Structurally different from the Bi-Real units above —
+        torchvision teacher checkpoints load weight-for-weight AND
+        compute the same logits (torchvision resnet.py BasicBlock;
+        reference builds teachers from torchvision at train.py:253-258).
+        """
+        identity = x
+        y = FloatConv(
+            self.features,
+            kernel_size=(3, 3),
+            strides=(self.strides, self.strides),
+            name="conv1",
+        )(x)
+        y = _batch_norm(train, "bn1", self.dtype)(y)
+        y = nn.relu(y)
+        y = FloatConv(
+            self.features, kernel_size=(3, 3), strides=(1, 1), name="conv2"
+        )(y)
+        y = _batch_norm(train, "bn2", self.dtype)(y)
+        if self.strides != 1 or x.shape[-1] != self.features:
+            identity = FloatConv(
+                self.features,
+                kernel_size=(1, 1),
+                strides=(self.strides, self.strides),
+                name="downsample_conv",
+            )(x)
+            identity = _batch_norm(train, "downsample_bn", self.dtype)(identity)
+        return nn.relu(y + identity)
 
 
 class BiResNet(nn.Module):
@@ -178,14 +210,17 @@ class BiResNet(nn.Module):
     stem: str = "imagenet"  # imagenet | cifar
     variant: str = "react"  # react | step2 | cifar | float
     act: str = "rprelu"  # rprelu | hardtanh | identity
+    dtype: Any = None  # compute dtype (e.g. jnp.bfloat16); params stay f32
 
     @nn.compact
     def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         if self.stem == "imagenet":
             x = FloatConv(
                 self.width, kernel_size=(7, 7), strides=(2, 2), name="conv1"
             )(x)
-            x = _batch_norm(train, "bn1")(x)
+            x = _batch_norm(train, "bn1", self.dtype)(x)
             x = nn.relu(x)
             # torch MaxPool2d(3, stride=2, padding=1)
             x = jnp.pad(
@@ -198,7 +233,7 @@ class BiResNet(nn.Module):
             x = FloatConv(
                 self.width, kernel_size=(3, 3), strides=(1, 1), name="conv1"
             )(x)
-            x = _batch_norm(train, "bn1")(x)
+            x = _batch_norm(train, "bn1", self.dtype)(x)
             x = nn.relu(x)
         else:
             raise ValueError(f"unknown stem: {self.stem!r}")
@@ -212,12 +247,15 @@ class BiResNet(nn.Module):
                     strides=strides,
                     variant=self.variant,
                     act=self.act,
+                    dtype=self.dtype,
                     name=f"layer{s + 1}_{b}",
                 )(x, train=train, tk=tk)
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool
-        x = nn.Dense(self.num_classes, name="fc")(x)
-        return x
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        # logits in f32: softmax/CE and top-k stay numerically stable
+        # regardless of the compute dtype
+        return x.astype(jnp.float32)
 
 
 class VGGSmallBinary(nn.Module):
@@ -227,9 +265,12 @@ class VGGSmallBinary(nn.Module):
 
     num_classes: int = 10
     variant: str = "cifar"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         conv_cls = _CONV_CLASSES[self.variant]
         widths = (128, 128, 256, 256, 512, 512)
         for i, w in enumerate(widths):
@@ -238,13 +279,13 @@ class VGGSmallBinary(nn.Module):
                 x = FloatConv(w, kernel_size=(3, 3), name=name)(x)
             else:
                 x = conv_cls(w, kernel_size=(3, 3), name=name)(x, tk=tk)
-            x = _batch_norm(train, f"bn{i + 1}")(x)
+            x = _batch_norm(train, f"bn{i + 1}", self.dtype)(x)
             if i % 2 == 1:
                 x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
             x = jnp.clip(x, -1.0, 1.0)
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.num_classes, name="fc")(x)
-        return x
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x.astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -116,24 +116,24 @@ def shard_variables(mesh: Mesh, variables):
     return out
 
 
-def shard_batch(mesh: Mesh, images: np.ndarray, labels: np.ndarray):
-    """Host-local batch → globally-sharded arrays over the 'data' axis.
+def shard_batch(mesh: Mesh, *arrays: np.ndarray):
+    """Host-local per-example arrays → globally-sharded arrays over the
+    'data' axis (variadic: images, labels, masks, ... — anything whose
+    leading axis is the batch).
 
     Single-process: a plain device_put with the batch sharding.
     Multi-host: each process passes its local shard and JAX assembles
     the global array (the DistributedSampler replacement's second
     half)."""
     if jax.process_count() > 1:
-        gx = jax.make_array_from_process_local_data(
-            batch_sharding(mesh, images.ndim), images
+        return tuple(
+            jax.make_array_from_process_local_data(
+                batch_sharding(mesh, a.ndim), a
+            )
+            for a in arrays
         )
-        gy = jax.make_array_from_process_local_data(
-            batch_sharding(mesh, 1), labels
-        )
-        return gx, gy
-    return (
-        jax.device_put(images, batch_sharding(mesh, images.ndim)),
-        jax.device_put(labels, batch_sharding(mesh, 1)),
+    return tuple(
+        jax.device_put(a, batch_sharding(mesh, a.ndim)) for a in arrays
     )
 
 
@@ -144,10 +144,20 @@ def create_sharded_state(mesh: Mesh, variables, tx, state_cls):
     DP, channel-sharded over 'model' when model_parallel > 1) BEFORE
     ``tx.init`` runs, so optimizer-state leaves inherit the param
     shardings (``zeros_like`` preserves sharding) — no separate
-    opt-state spec needed.
+    opt-state spec needed. Remaining single-device leaves (the step
+    counter, optimizer schedule counts) are replicated onto the mesh so
+    EVERY leaf carries a mesh sharding — checkpoint restore relies on
+    that to re-place leaves exactly.
     """
     placed = shard_variables(mesh, variables)
-    return state_cls.create(placed, tx)
+    state = state_cls.create(placed, tx)
+
+    def _mesh_place(x):
+        if hasattr(x, "sharding") and isinstance(x.sharding, NamedSharding):
+            return x
+        return jax.device_put(x, replicated(mesh))
+
+    return jax.tree_util.tree_map(_mesh_place, state)
 
 
 def jit_train_step(step_fn) -> Any:

@@ -42,15 +42,20 @@ Array = jax.Array
 Batch = Tuple[Array, Array]  # (images NHWC float32, labels int)
 
 
-def topk_correct(logits: Array, labels: Array, ks=(1, 5)) -> Dict[str, Array]:
+def topk_correct(
+    logits: Array, labels: Array, ks=(1, 5), valid: Optional[Array] = None
+) -> Dict[str, Array]:
     """Counts of top-k correct predictions (↔ utils.accuracy,
     reference ``utils/utils.py:72-85``, which returns percentages —
-    counts sum exactly under psum/meters)."""
+    counts sum exactly under psum/meters). ``valid`` (0/1 per example)
+    masks padded rows out of the counts."""
     out = {}
     k_max = max(ks)
     k_max = min(k_max, logits.shape[-1])
     _, top = jax.lax.top_k(logits, k_max)
-    hit = top == labels[:, None]
+    hit = (top == labels[:, None]).astype(jnp.int32)
+    if valid is not None:
+        hit = hit * valid.astype(jnp.int32)[:, None]
     for k in ks:
         kk = min(k, logits.shape[-1])
         out[f"top{k}"] = jnp.sum(hit[:, :kk])
@@ -208,16 +213,26 @@ def make_ts_train_step(
 
 
 def make_eval_step(model) -> Callable:
-    """Validation step (↔ ``validate()``, ``train.py:677-714``)."""
+    """Validation step (↔ ``validate()``, ``train.py:677-714``).
 
-    def eval_step(state: TrainState, batch: Batch):
-        images, labels = batch
+    Takes ``(images, labels, valid)``: eval batches are padded to a
+    fixed shape (so every host compiles one program and runs the same
+    number of steps on a pod) and ``valid`` masks the padding out of
+    every reduction. Returns SUMS — with sharded inputs GSPMD reduces
+    them globally, so each host sees the global counts (the reference's
+    ``validate()`` had no cross-rank reduction; host-local accuracy
+    drove best-model selection)."""
+
+    def eval_step(state: TrainState, batch):
+        images, labels, valid = batch
         logits = model.apply(state.variables, images, train=False)
-        ce = softmax_cross_entropy(logits, labels)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        v = valid.astype(nll.dtype)
         return {
-            "loss": ce,
-            **topk_correct(logits, labels),
-            "count": jnp.int32(labels.shape[0]),
+            "loss_sum": jnp.sum(nll * v),
+            **topk_correct(logits, labels, valid=valid),
+            "count": jnp.sum(valid.astype(jnp.int32)),
         }
 
     return eval_step

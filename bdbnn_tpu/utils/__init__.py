@@ -5,7 +5,15 @@ from bdbnn_tpu.utils.logging_utils import (
     make_log_dir,
     setup_logger,
 )
-from bdbnn_tpu.utils.meters import AverageMeter, ProgressMeter, Timer, format_eta
+from bdbnn_tpu.utils.meters import (
+    AverageMeter,
+    DeviceMetrics,
+    Mean,
+    ProgressLog,
+    ProgressMeter,
+    Throughput,
+    format_eta,
+)
 
 __all__ = [
     "checkpoint",
@@ -17,7 +25,10 @@ __all__ = [
     "make_log_dir",
     "setup_logger",
     "AverageMeter",
+    "DeviceMetrics",
+    "Mean",
+    "ProgressLog",
     "ProgressMeter",
-    "Timer",
+    "Throughput",
     "format_eta",
 ]

@@ -8,16 +8,29 @@ top-1 improves. The payload carries ``{epoch, arch, state, best_acc1}``
 weights only, restarting the schedule (↔ ``--reset_resume``,
 ``train.py:355-361``).
 
-Multi-host: only process 0 writes (↔ the reference's rank-0 guard,
-``train.py:431-432``) — with fully-replicated or addressable shardings
-this is safe; Orbax handles the general case.
+Crash safety: the previous checkpoint is never deleted before the new
+one is durable. Saves go to ``checkpoint.tmp`` and are committed by
+rename (old → ``checkpoint.old`` → removed only after the new dir is in
+place); :func:`load_checkpoint` falls back to ``checkpoint.old`` if a
+crash left no committed dir. (The reference wrote a fresh file then
+copied, ``utils/utils.py:21-25`` — same property, torch idiom.)
+
+Sharding: restore returns a state PLACED LIKE THE TEMPLATE — every leaf
+is device_put with the template leaf's sharding (params, batch_stats,
+optimizer state alike), so resuming a mesh run preserves the exact
+GSPMD layout instead of re-placing by jit default.
+
+Multi-host: process 0 materializes and writes (replicated-DP state is
+fully addressable per host). TP-sharded multi-host state would need the
+all-process Orbax path; single-host TP (one process, many chips) works
+— ``jax.device_get`` assembles across local devices.
 """
 
 from __future__ import annotations
 
 import os
 import shutil
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import orbax.checkpoint as ocp
@@ -28,6 +41,19 @@ BEST_NAME = "model_best"
 
 def _checkpointer() -> ocp.PyTreeCheckpointer:
     return ocp.PyTreeCheckpointer()
+
+
+def _commit(tmp: str, target: str) -> None:
+    """Atomically swap ``tmp`` into ``target``, keeping the previous
+    checkpoint as ``<target>.old`` until the swap lands."""
+    old = target + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(target):
+        os.rename(target, old)
+    os.rename(tmp, target)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def save_checkpoint(
@@ -50,14 +76,31 @@ def save_checkpoint(
     }
     os.makedirs(save_path, exist_ok=True)
     target = os.path.join(save_path, CKPT_NAME)
-    if os.path.exists(target):
-        shutil.rmtree(target)
-    _checkpointer().save(target, payload)
+    tmp = target + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    _checkpointer().save(tmp, payload)
+    _commit(tmp, target)
     if is_best:
         best = os.path.join(save_path, BEST_NAME)
-        if os.path.exists(best):
-            shutil.rmtree(best)
-        shutil.copytree(target, best)
+        btmp = best + ".tmp"
+        if os.path.exists(btmp):
+            shutil.rmtree(btmp)
+        shutil.copytree(target, btmp)
+        _commit(btmp, best)
+
+
+def _resolve_ckpt_dir(path: str) -> str:
+    """Accept a run dir or a checkpoint dir; prefer the committed
+    checkpoint, falling back to ``.old`` after a mid-save crash."""
+    if os.path.isdir(path):
+        for name in (CKPT_NAME, CKPT_NAME + ".old"):
+            cand = os.path.join(path, name)
+            if os.path.isdir(cand):
+                return cand
+    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
+        return path + ".old"
+    return path
 
 
 def load_checkpoint(
@@ -66,14 +109,14 @@ def load_checkpoint(
     *,
     reset_resume: bool = False,
 ) -> Dict[str, Any]:
-    """Restore a checkpoint against a template state.
+    """Restore a checkpoint against a (possibly mesh-sharded) template.
 
-    Returns ``{epoch, arch, best_acc1, state}``. With ``reset_resume``
-    the returned epoch/best are zeroed and only weights (params +
-    batch_stats) are taken from the checkpoint — the optimizer state
-    and schedule restart (↔ ``--reset_resume``)."""
-    if os.path.isdir(path) and os.path.isdir(os.path.join(path, CKPT_NAME)):
-        path = os.path.join(path, CKPT_NAME)
+    Returns ``{epoch, arch, best_acc1, state}`` with every state leaf
+    placed per the template leaf's sharding. With ``reset_resume`` the
+    returned epoch/best are zeroed and only weights (params +
+    batch_stats) are taken from the checkpoint — the optimizer state and
+    schedule restart (↔ ``--reset_resume``)."""
+    path = _resolve_ckpt_dir(path)
     template = {
         "epoch": 0,
         "arch": "",
@@ -81,15 +124,38 @@ def load_checkpoint(
         "state": jax.device_get(state_template),
     }
     payload = _checkpointer().restore(path, item=template)
+    # orbax may restore 'state' as the TrainState node (template-typed)
+    # or as a plain dict depending on version — normalize to attributes
+    restored_state = payload["state"]
+
+    def _field(name):
+        if isinstance(restored_state, dict):
+            return restored_state[name]
+        return getattr(restored_state, name)
+
+    def _placed(host_tree, like_tree):
+        return jax.tree_util.tree_map(
+            lambda arr, like: jax.device_put(arr, like.sharding)
+            if hasattr(like, "sharding")
+            else arr,
+            host_tree,
+            like_tree,
+        )
+
     state = state_template.replace(
-        params=payload["state"]["params"],
-        batch_stats=payload["state"]["batch_stats"],
+        params=_placed(_field("params"), state_template.params),
+        batch_stats=_placed(_field("batch_stats"), state_template.batch_stats),
     )
     if reset_resume:
-        return {"epoch": 0, "arch": payload["arch"], "best_acc1": 0.0, "state": state}
+        return {
+            "epoch": 0,
+            "arch": payload["arch"],
+            "best_acc1": 0.0,
+            "state": state,
+        }
     state = state.replace(
-        step=payload["state"]["step"],
-        opt_state=payload["state"]["opt_state"],
+        step=_placed(_field("step"), state_template.step),
+        opt_state=_placed(_field("opt_state"), state_template.opt_state),
     )
     return {
         "epoch": int(payload["epoch"]),

@@ -1,96 +1,180 @@
-"""Meters / progress display / ETA — parity with reference
-``utils/utils.py:27-69`` and the ETA printer at ``train.py:538-550``."""
+"""Training meters, redesigned for the async-dispatch model of JAX.
+
+The reference's meters (``utils/utils.py:27-69``, the stock
+pytorch/examples boilerplate) call ``.item()`` on every batch, forcing a
+device→host sync per step. Under XLA that sync is the throughput killer:
+it drains the dispatch pipeline and serializes steps. The design here
+splits metric handling in two:
+
+- :class:`DeviceMetrics` accumulates the step's metric dict as lazy
+  on-device sums (pure ``jnp`` adds, no host transfer). The host fetches
+  ONCE per print interval via :meth:`DeviceMetrics.drain`.
+- :class:`Mean` / :class:`Throughput` are plain host-side aggregators
+  fed from the drained sums.
+
+``ProgressLog`` renders the reference's per-batch progress lines and ETA
+(↔ ``train.py:535-550``) from those aggregators.
+"""
 
 from __future__ import annotations
 
 import time
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
 
 
-class AverageMeter:
-    """Running value/avg/sum/count meter (↔ utils/utils.py:27-47)."""
+class DeviceMetrics:
+    """Lazy on-device accumulator for dicts of scalar device arrays.
 
-    def __init__(self, name: str, fmt: str = ":f"):
-        self.name = name
-        self.fmt = fmt
-        self.reset()
+    ``add`` is O(1) host work (queues elementwise adds); ``drain`` does
+    one blocking fetch, returns the summed dict since the previous
+    drain, and resets. Keys are summed — emit *sums and counts* from the
+    step (e.g. top-k correct counts + example count), never pre-divided
+    means, so drained values aggregate exactly.
+    """
 
-    def reset(self) -> None:
-        self.val = 0.0
-        self.avg = 0.0
-        self.sum = 0.0
-        self.count = 0
+    def __init__(self) -> None:
+        self._acc: Optional[Dict[str, jax.Array]] = None
+        self._steps = 0
 
-    def update(self, val: float, n: int = 1) -> None:
-        self.val = float(val)
-        self.sum += float(val) * n
-        self.count += n
-        self.avg = self.sum / max(self.count, 1)
+    def add(self, metrics: Dict[str, jax.Array]) -> None:
+        if self._acc is None:
+            self._acc = dict(metrics)
+        else:
+            self._acc = {
+                k: self._acc[k] + v for k, v in metrics.items()
+            }
+        self._steps += 1
 
-    def get_avg(self) -> float:
-        return self.avg
+    @property
+    def pending_steps(self) -> int:
+        return self._steps
 
-    def __str__(self) -> str:
-        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
-        return fmtstr.format(name=self.name, val=self.val, avg=self.avg)
+    def drain(self) -> Dict[str, float]:
+        """One host sync; returns python-float sums and resets."""
+        if self._acc is None:
+            return {}
+        fetched = jax.device_get(self._acc)
+        self._acc = None
+        self._steps = 0
+        return {k: float(v) for k, v in fetched.items()}
 
 
-class ProgressMeter:
-    """Formatted per-batch progress lines (↔ utils/utils.py:50-69)."""
+class Mean:
+    """Weighted streaming mean with a last-drained display value."""
 
-    def __init__(
-        self,
-        num_batches: int,
-        meters: Iterable[AverageMeter],
-        logger=None,
-        prefix: str = "",
-    ):
-        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
-        self.meters = list(meters)
+    def __init__(self, label: str, spec: str = "{:.4f}") -> None:
+        self.label = label
+        self.spec = spec
+        self.total = 0.0
+        self.weight = 0.0
+        self.last = 0.0
+
+    def add(self, value: float, weight: float = 1.0) -> None:
+        self.last = value
+        self.total += value * weight
+        self.weight += weight
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.weight if self.weight else 0.0
+
+    def render(self) -> str:
+        return (
+            f"{self.label} {self.spec.format(self.last)}"
+            f" (avg {self.spec.format(self.mean)})"
+        )
+
+
+class Throughput:
+    """Examples/sec over drain intervals + a cumulative rate.
+
+    Feeds the images/sec/chip instrumentation SURVEY.md §5.1 calls for
+    (the reference only had wall-clock meters)."""
+
+    def __init__(self) -> None:
+        self.t0 = time.perf_counter()
+        self.mark = self.t0
+        self.examples = 0.0
+        self.rate = 0.0
+
+    def tick(self, n_examples: float) -> float:
+        """Record n examples since the last tick; returns interval rate."""
+        now = time.perf_counter()
+        dt = max(now - self.mark, 1e-9)
+        self.mark = now
+        self.examples += n_examples
+        self.rate = n_examples / dt
+        return self.rate
+
+    @property
+    def cumulative(self) -> float:
+        return self.examples / max(time.perf_counter() - self.t0, 1e-9)
+
+    def per_chip(self, n_chips: int) -> float:
+        return self.rate / max(n_chips, 1)
+
+
+class ProgressLog:
+    """Renders 'Epoch [e][ step/total ] metric lines + ETA'."""
+
+    def __init__(self, total_steps: int, logger=None, prefix: str = "") -> None:
+        self.total_steps = total_steps
         self.logger = logger
         self.prefix = prefix
 
-    def display(self, batch: int) -> str:
-        entries = [self.prefix + self.batch_fmtstr.format(batch)]
-        entries += [str(m) for m in self.meters]
-        line = "\t".join(entries)
+    def emit(self, step: int, parts: Iterable[str]) -> str:
+        width = len(str(max(self.total_steps, 1)))
+        head = f"{self.prefix}[{step:>{width}d}/{self.total_steps}]"
+        line = "\t".join([head, *parts])
         if self.logger is not None:
             self.logger.info(line)
         return line
 
-    @staticmethod
-    def _get_batch_fmtstr(num_batches: int) -> str:
-        num_digits = len(str(num_batches // 1))
-        fmt = "{:" + str(num_digits) + "d}"
-        return "[" + fmt + "/" + fmt.format(num_batches) + "]"
-
 
 def format_eta(remain_seconds: float) -> str:
-    """Remaining-time string (↔ train.py:541-550)."""
-    seconds = (remain_seconds // 1) % 60
-    minutes = (remain_seconds // 60) % 60
-    hours = (remain_seconds // 3600) % 24
-    days = remain_seconds // 86400
-    out = ""
-    if days > 0:
-        out += f"{int(days)} days, "
-    if hours > 0:
-        out += f"{int(hours)} hr, "
-    if minutes > 0:
-        out += f"{int(minutes)} min, "
-    if seconds > 0:
-        out += f"{int(seconds)} sec, "
-    return out
+    """Compact remaining-time string: '2d 03:14:07' / '03:14:07'."""
+    s = max(int(remain_seconds), 0)
+    days, s = divmod(s, 86400)
+    hours, s = divmod(s, 3600)
+    minutes, seconds = divmod(s, 60)
+    hms = f"{hours:02d}:{minutes:02d}:{seconds:02d}"
+    return f"{days}d {hms}" if days else hms
 
 
-class Timer:
-    """Batch/data-time tracking helper around the meters."""
+# -- thin compatibility shims over the new primitives -----------------------
 
-    def __init__(self):
-        self.end = time.time()
 
-    def lap(self) -> float:
-        now = time.time()
-        dt = now - self.end
-        self.end = now
-        return dt
+class AverageMeter(Mean):
+    """Reference-API-compatible alias of :class:`Mean`
+    (name/fmt ctor + ``update``/``avg``; ↔ utils/utils.py:27-47)."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        spec = "{" + fmt + "}" if fmt.startswith(":") else fmt
+        super().__init__(name, spec)
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.add(float(val), n)
+
+    @property
+    def avg(self) -> float:
+        return self.mean
+
+    def get_avg(self) -> float:
+        return self.mean
+
+    def __str__(self) -> str:
+        return self.render()
+
+
+class ProgressMeter:
+    """Reference-API-compatible wrapper over :class:`ProgressLog`."""
+
+    def __init__(self, num_batches, meters, logger=None, prefix: str = ""):
+        self._log = ProgressLog(num_batches, logger, prefix)
+        self.meters = list(meters)
+
+    def display(self, batch: int) -> str:
+        return self._log.emit(batch, (str(m) for m in self.meters))

@@ -1,30 +1,48 @@
 """Benchmark harness: flagship train-step throughput on the real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Metric: images/sec/chip for the full BD-BNN training step (forward +
 backward + optimizer + kurtosis regularization) on binary ResNet-18 at
-224×224 — the workload of BASELINE config 3 ("ResNet-18 BD-BNN,
-ImageNet, single-chip, kurtosis reg only").
+224×224 in bf16 — the workload of BASELINE config 3 ("ResNet-18 BD-BNN,
+ImageNet, single-chip, kurtosis reg only"). The f32 rate is reported
+alongside so the bf16 speedup is visible.
 
-vs_baseline normalizes against the reference's GPU throughput for the
-same step. The reference repo publishes no numbers (SURVEY.md §6), so
-the anchor is an estimate pinned here: ~900 images/sec on a modern
-training GPU for ReActNet-style binary ResNet-18 with FP32 master
-weights (binary nets run at FP speed on GPUs — cuDNN has no 1-bit
-path, matching the reference's stock-PyTorch convs). The BASELINE.json
-north star asks for ≥1.5× chip-normalized.
+Robustness: the measurement runs in a SUBPROCESS with a hard timeout —
+a hung or unavailable TPU backend (remote PJRT plugins can block in
+backend init) is killed and retried with backoff; after the final
+attempt a parseable JSON error line is printed instead of a traceback.
+
+Baseline provenance: the reference repo publishes no throughput numbers
+(SURVEY.md §6) and this container has no network egress, so
+``vs_baseline`` normalizes against a pinned engineering estimate of the
+reference's per-GPU rate for this exact step: ~900 images/sec — binary
+ResNet-18 with FP latent weights trains at FP32 ResNet-18 speed on
+GPUs (stock cuDNN convs, no 1-bit path; reference ``train.py:9-19``),
+and FP32 ResNet-18 ImageNet training sits in the 700–1100 img/s range
+on A100/H100-class parts. Override with env BDBNN_BENCH_BASELINE when a
+measured anchor exists. The north star (BASELINE.json) is ≥1.5×
+chip-normalized.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-BASELINE_IMAGES_PER_SEC_PER_CHIP = 900.0
+BASELINE_IMAGES_PER_SEC_PER_CHIP = float(
+    os.environ.get("BDBNN_BENCH_BASELINE", "900.0")
+)
+METRIC = "train_step_images_per_sec_per_chip"
+UNIT = "images/sec/chip"
 
 
-def main() -> None:
+def _measure(dtype: str, batch: int, iters: int) -> float:
+    """Images/sec for the jitted flagship train step at ``dtype``."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -37,8 +55,7 @@ def main() -> None:
         make_train_step,
     )
 
-    batch = 64
-    model = create_model("resnet18", "imagenet")
+    model = create_model("resnet18", "imagenet", dtype=dtype)
     x = jnp.asarray(
         np.random.default_rng(0).normal(size=(batch, 224, 224, 3)),
         jnp.float32,
@@ -67,30 +84,99 @@ def main() -> None:
     tk = (jnp.float32(1.0), jnp.float32(1.0))
     gate = jnp.float32(1.0)
 
-    # warmup / compile
-    state, metrics = step(state, (x, y), tk, gate)
+    # warmup / compile + 2 steady steps
+    for _ in range(3):
+        state, metrics = step(state, (x, y), tk, gate)
     jax.block_until_ready(metrics["loss"])
+    print(f"[bench] {dtype}: compiled, timing {iters} steps", file=sys.stderr)
 
-    iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, (x, y), tk, gate)
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
+    assert bool(jnp.isfinite(metrics["loss"])), "non-finite loss in bench"
+    return batch * iters / dt
 
-    images_per_sec = batch * iters / dt
+
+def worker_main(args) -> None:
+    import jax
+
     n_chips = max(jax.device_count(), 1)
-    per_chip = images_per_sec / n_chips
+    print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
+
+    bf16 = _measure("bfloat16", args.batch, args.iters) / n_chips
+    f32 = _measure("float32", args.batch, args.iters) / n_chips if args.compare else None
+
+    out = {
+        "metric": METRIC,
+        "value": round(bf16, 2),
+        "unit": UNIT,
+        "vs_baseline": round(bf16 / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "dtype": "bfloat16",
+        "batch": args.batch,
+        "n_chips": n_chips,
+        "platform": jax.devices()[0].platform,
+    }
+    if f32 is not None:
+        out["f32_images_per_sec_per_chip"] = round(f32, 2)
+        out["bf16_speedup_vs_f32"] = round(bf16 / f32, 3)
+    print(json.dumps(out))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--attempts", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=540.0)
+    ap.add_argument("--no-compare", dest="compare", action="store_false",
+                    help="skip the f32 comparison run")
+    args = ap.parse_args()
+
+    if args.worker:
+        worker_main(args)
+        return
+
+    err_tail = ""
+    for attempt in range(args.attempts):
+        cmd = [
+            sys.executable, os.path.abspath(__file__), "--worker",
+            "--batch", str(args.batch), "--iters", str(args.iters),
+        ]
+        if not args.compare:
+            cmd.append("--no-compare")
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=args.timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired as e:
+            err_tail = f"attempt {attempt + 1}: timeout after {args.timeout}s"
+            print(f"[bench] {err_tail}", file=sys.stderr)
+            time.sleep(min(30.0, 5.0 * (attempt + 1)))
+            continue
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                print(line)
+                return
+        err_tail = (proc.stderr or proc.stdout or "")[-800:]
+        print(
+            f"[bench] attempt {attempt + 1} failed rc={proc.returncode}",
+            file=sys.stderr,
+        )
+        time.sleep(min(30.0, 5.0 * (attempt + 1)))
 
     print(
         json.dumps(
             {
-                "metric": "train_step_images_per_sec_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(
-                    per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
-                ),
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": UNIT,
+                "vs_baseline": 0.0,
+                "error": f"all {args.attempts} attempts failed: {err_tail}",
             }
         )
     )

@@ -18,6 +18,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# The env var alone is NOT enough in environments where a PJRT plugin's
+# sitecustomize has already called jax.config.update("jax_platforms", ...)
+# at interpreter start (config updates override the env var). Re-pin to
+# CPU here, before any backend is initialized, so jax.devices() never
+# dials a remote TPU from a unit test.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -32,6 +32,19 @@ def _model():
     )
 
 
+def _float_model():
+    # Continuous (no sign()) twin for numerical-equivalence assertions:
+    # binary nets are chaotic under reduction-order noise (any activation
+    # or latent weight within float-eps of 0 flips its sign() between
+    # two valid computation orders), so DP≡single-device can only be
+    # asserted bitwise-tight on the float variant. The property under
+    # test — GSPMD psum == full-batch gradient — is the same either way.
+    return BiResNet(
+        stage_sizes=(1, 1), num_classes=4, width=8,
+        stem="cifar", variant="float", act="hardtanh",
+    )
+
+
 def _batch(n=16, hw=8, seed=0):
     rng = np.random.default_rng(seed)
     x = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
@@ -82,7 +95,10 @@ class TestMesh:
 
 
 class TestDPEquivalence:
-    def _run_single(self, model, variables, batch, steps=3):
+    # Equivalence is asserted on the FLOAT model (see _float_model) over
+    # two steps — the DDP-allreduce contract of reference
+    # train.py:292-314, validated the GSPMD way.
+    def _run_single(self, model, variables, batch, steps=2):
         tx = make_optimizer(
             variables["params"], dataset="cifar10", lr=0.05,
             epochs=10, steps_per_epoch=100,
@@ -98,7 +114,7 @@ class TestDPEquivalence:
             )
         return state, metrics
 
-    def _run_sharded(self, model, variables, batch, steps=3, model_parallel=1):
+    def _run_sharded(self, model, variables, batch, steps=2, model_parallel=1):
         mesh = make_mesh(model_parallel=model_parallel)
         tx = make_optimizer(
             variables["params"], dataset="cifar10", lr=0.05,
@@ -115,7 +131,7 @@ class TestDPEquivalence:
         return state, metrics
 
     def test_dp_equals_single_device(self):
-        model = _model()
+        model = _float_model()
         batch = _batch(n=16)
         variables = model.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=True
@@ -123,18 +139,18 @@ class TestDPEquivalence:
         s_single, m_single = self._run_single(model, variables, batch)
         s_dp, m_dp = self._run_sharded(model, variables, batch)
         assert float(m_single["loss"]) == pytest.approx(
-            float(m_dp["loss"]), rel=2e-4
+            float(m_dp["loss"]), rel=1e-5
         )
         for a, b in zip(
             jax.tree_util.tree_leaves(s_single.params),
             jax.tree_util.tree_leaves(s_dp.params),
         ):
             np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), atol=2e-4
+                np.asarray(a), np.asarray(b), atol=1e-5
             )
 
     def test_dp_plus_tp_equals_single_device(self):
-        model = _model()
+        model = _float_model()
         batch = _batch(n=16, seed=4)
         variables = model.init(
             jax.random.PRNGKey(1), jnp.zeros((1, 8, 8, 3)), train=True
@@ -142,7 +158,36 @@ class TestDPEquivalence:
         s_single, m_single = self._run_single(model, variables, batch)
         s_tp, m_tp = self._run_sharded(model, variables, batch, model_parallel=2)
         assert float(m_single["loss"]) == pytest.approx(
-            float(m_tp["loss"]), rel=2e-4
+            float(m_tp["loss"]), rel=1e-5
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s_single.params),
+            jax.tree_util.tree_leaves(s_tp.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
+    def test_binary_model_trains_on_mesh(self):
+        # The binary net itself can't be compared bitwise across
+        # shardings (sign() chaos, see _float_model) — assert it runs
+        # sharded with finite loss and updated params instead.
+        model = _model()
+        batch = _batch(n=16)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=True
+        )
+        # snapshot before running: jit donation may reuse these buffers
+        before = [
+            np.asarray(a)
+            for a in jax.tree_util.tree_leaves(variables["params"])
+        ]
+        s_dp, m_dp = self._run_sharded(model, variables, batch)
+        assert np.isfinite(float(m_dp["loss"]))
+        after = jax.tree_util.tree_leaves(s_dp.params)
+        assert any(
+            not np.allclose(np.asarray(a), np.asarray(b))
+            for a, b in zip(before, after)
         )
 
     def test_batch_is_actually_sharded(self):

@@ -1,0 +1,212 @@
+"""Teacher-checkpoint ingestion tests.
+
+Round-1 advice found the float-twin forward was NOT torchvision
+BasicBlock semantics, so ingested teachers computed wrong logits while
+key/shape checks passed. These tests pin FORWARD parity against a torch
+oracle implementing exact torchvision BasicBlock semantics (the
+reference builds teachers from torchvision models, ``train.py:253-258``),
+plus the strict-overlay guarantees (shape mismatch and unconsumed /
+missing keys raise — torch ``load_state_dict`` is strict by default).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+import jax
+import jax.numpy as jnp
+
+from bdbnn_tpu.models.resnet import BiResNet
+from bdbnn_tpu.models.torch_import import convert_torch_state_dict
+from bdbnn_tpu.train.loop import _overlay
+
+
+class TorchBasicBlock(tnn.Module):
+    """torchvision.models.resnet.BasicBlock, verbatim semantics."""
+
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.relu = tnn.ReLU(inplace=True)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout),
+            )
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class TorchMiniResNet(tnn.Module):
+    """CIFAR-stem BasicBlock ResNet matching
+    BiResNet(stage_sizes=(1, 1), width=8, stem='cifar', variant='float')
+    with torchvision parameter naming."""
+
+    def __init__(self, width=8, num_classes=4):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, width, 3, 1, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.relu = tnn.ReLU(inplace=True)
+        self.layer1 = tnn.Sequential(TorchBasicBlock(width, width, 1))
+        self.layer2 = tnn.Sequential(TorchBasicBlock(width, 2 * width, 2))
+        self.fc = tnn.Linear(2 * width, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def _randomized_oracle(seed=0):
+    torch.manual_seed(seed)
+    net = TorchMiniResNet()
+    # randomize BN affine + running stats so parity is non-trivial
+    with torch.no_grad():
+        for m in net.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.weight.uniform_(0.5, 1.5)
+                m.bias.uniform_(-0.3, 0.3)
+                m.running_mean.uniform_(-0.2, 0.2)
+                m.running_var.uniform_(0.5, 1.5)
+    net.eval()
+    return net
+
+
+def _float_twin():
+    return BiResNet(
+        stage_sizes=(1, 1), num_classes=4, width=8,
+        stem="cifar", variant="float", act="identity",
+    )
+
+
+class TestFloatTeacherParity:
+    def test_forward_matches_torch_oracle(self):
+        net = _randomized_oracle()
+        # translate layerN.M keys: mini-net uses layer1/layer2 Sequentials
+        sd = {k: v for k, v in net.state_dict().items()}
+        converted = convert_torch_state_dict(sd)
+
+        model = _float_twin()
+        template = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False
+        )
+        variables = {
+            "params": _overlay(
+                template["params"], converted["params"],
+                scope="t", allow_missing=False,
+            ),
+            "batch_stats": _overlay(
+                template["batch_stats"], converted["batch_stats"],
+                scope="t", allow_missing=False,
+            ),
+        }
+
+        x = np.random.default_rng(1).normal(size=(4, 16, 16, 3)).astype(
+            np.float32
+        )
+        with torch.no_grad():
+            ref = net(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
+        out = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+    def test_dataparallel_module_prefix(self):
+        """``module.``-prefixed keys (DataParallel teachers, reference
+        ``train.py:258, 269``) convert identically."""
+        net = _randomized_oracle(seed=3)
+        sd = {f"module.{k}": v for k, v in net.state_dict().items()}
+        converted = convert_torch_state_dict(sd)
+        assert "conv1" in converted["params"]
+        assert "layer2_0" in converted["params"]
+
+
+class TestOverlayStrictness:
+    def _template(self):
+        model = _float_twin()
+        return model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False
+        )
+
+    def test_shape_mismatch_raises(self):
+        tmpl = self._template()["params"]
+        bad = {"conv1": {"weight": np.zeros((3, 3, 3, 99), np.float32)}}
+        with pytest.raises(ValueError, match="shape mismatch"):
+            _overlay(tmpl, bad, scope="t", allow_missing=True)
+
+    def test_unconsumed_keys_raise(self):
+        tmpl = self._template()["params"]
+        bad = {"nonexistent_layer": {"weight": np.zeros((1,), np.float32)}}
+        with pytest.raises(ValueError, match="not consumed"):
+            _overlay(tmpl, bad, scope="t", allow_missing=True)
+
+    def test_missing_leaves_raise_when_strict(self):
+        tmpl = self._template()["params"]
+        partial = {
+            "conv1": {
+                "weight": np.zeros((3, 3, 3, 8), np.float32)
+            }
+        }
+        with pytest.raises(ValueError, match="missing from checkpoint"):
+            _overlay(tmpl, partial, scope="t", allow_missing=False)
+        # and succeeds when partial init is explicitly allowed
+        merged = _overlay(tmpl, partial, scope="t", allow_missing=True)
+        assert merged["conv1"]["weight"].shape == (3, 3, 3, 8)
+
+    def test_float_weight_alias(self):
+        """FP checkpoint 'weight' lands on binary latent 'float_weight'
+        (the QAT-name fallback, reference train.py:404)."""
+        student = BiResNet(
+            stage_sizes=(1, 1), num_classes=4, width=8,
+            stem="cifar", variant="cifar", act="hardtanh",
+        )
+        tmpl = student.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False
+        )["params"]
+        w = np.full((3, 3, 8, 8), 0.5, np.float32)
+        loaded = {"layer1_0": {"conv1": {"weight": w}}}
+        merged = _overlay(
+            tmpl, loaded, scope="t", allow_missing=True,
+            alias_float_weight=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(merged["layer1_0"]["conv1"]["float_weight"]), w
+        )
+
+
+class TestTeacherBuildGuards:
+    def test_ts_without_teacher_ckpt_raises(self):
+        from bdbnn_tpu.configs.config import RunConfig
+        from bdbnn_tpu.train.loop import build_teacher
+
+        cfg = RunConfig(
+            dataset="cifar10",
+            arch_teacher="resnet20_float",
+            imagenet_setting_step_2_ts=True,
+        )
+        with pytest.raises(ValueError, match="random-init"):
+            build_teacher(cfg, 32)
+
+    def test_ts_smoke_escape_hatch(self):
+        from bdbnn_tpu.configs.config import RunConfig
+        from bdbnn_tpu.train.loop import build_teacher
+
+        cfg = RunConfig(
+            dataset="cifar10",
+            arch_teacher="resnet20_float",
+            imagenet_setting_step_2_ts=True,
+            allow_random_teacher=True,
+        )
+        teacher, variables = build_teacher(cfg, 32)
+        assert "params" in variables
