@@ -194,6 +194,18 @@ class Pipeline:
             return per_host // self.batch_size
         return math.ceil(per_host / self.batch_size)
 
+    def eval_steps(self) -> int:
+        """Number of eval steps EVERY host must execute — computed from
+        the LARGEST per-host shard, so hosts with a smaller shard pad
+        with zero-valid batches instead of skipping the collective (an
+        unequal step count would deadlock a pod mid-validation)."""
+        largest = math.ceil(len(self.ds) / self.num_hosts)
+        return math.ceil(largest / self.batch_size)
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        return tuple(self.ds.images.shape[1:])
+
     def _epoch_batches(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         idx = host_shard_indices(
             len(self.ds),
@@ -263,6 +275,15 @@ class ImageFolderPipeline:
         if self.train:
             return per_host // self.batch_size
         return math.ceil(per_host / self.batch_size)
+
+    def eval_steps(self) -> int:
+        """See :meth:`Pipeline.eval_steps` — pod-uniform eval count."""
+        largest = math.ceil(len(self.folder) / self.num_hosts)
+        return math.ceil(largest / self.batch_size)
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        return (self.image_size, self.image_size, 3)
 
     def _load_one(self, index: int, rng: np.random.Generator) -> np.ndarray:
         im, label = self.folder.load(index)

@@ -1,1 +1,23 @@
+"""TPU fast-path kernels for the binary compute hot spot.
 
+See :mod:`bdbnn_tpu.nn.kernels.binary_conv` for the int8 MXU
+implicit-GEMM binary convolution (and the analysis of why int8-on-MXU
+beats XNOR-popcount-on-VPU on TPU). The DEFAULT implementation is the
+stock XLA conv; flip it with :func:`set_default_impl` once
+``bench_kernels.py`` / ``bench.py`` record an int8 win on real
+hardware — every path is bit-exact for ±1 operands.
+"""
+
+from bdbnn_tpu.nn.kernels.binary_conv import (
+    binary_conv2d_mxu,
+    default_impl,
+    get_default_impl,
+    set_default_impl,
+)
+
+__all__ = [
+    "binary_conv2d_mxu",
+    "default_impl",
+    "get_default_impl",
+    "set_default_impl",
+]

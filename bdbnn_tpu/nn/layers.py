@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from bdbnn_tpu.nn.binarize import approx_sign, binarize_act, binarize_weight
+from bdbnn_tpu.nn.binarize import approx_sign, binarize_act, ste_sign
+from bdbnn_tpu.nn.kernels import binary_conv2d_mxu
 
 Array = jax.Array
 
@@ -105,9 +106,21 @@ class _BinaryConvBase(nn.Module):
         )
 
     def binary_conv(self, xb: Array, in_features: int) -> Array:
+        """±alpha binary conv, routed through
+        :func:`bdbnn_tpu.nn.kernels.binary_conv2d_mxu`. The default
+        implementation is the stock XLA conv; the int8 MXU fast paths
+        are opt-in (``kernels.set_default_impl``) until bench.py records
+        a measured win on real hardware — all paths are bit-exact for ±1
+        operands, see nn/kernels/binary_conv.py."""
         w = self.latent_weight(in_features).astype(xb.dtype)
-        wb = binarize_weight(w)
-        return conv2d(xb, wb, strides=self.strides, padding=self.padding)
+        signed = ste_sign(w)
+        reduce_axes = tuple(range(w.ndim - 1))
+        alpha = jax.lax.stop_gradient(
+            jnp.mean(jnp.abs(w), axis=reduce_axes)
+        )
+        return binary_conv2d_mxu(
+            xb, signed, alpha, strides=self.strides, padding=self.padding
+        )
 
 
 class BinaryConvReact(_BinaryConvBase):

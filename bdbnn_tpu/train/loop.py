@@ -238,6 +238,25 @@ def _overlay(template, loaded, *, scope: str, allow_missing: bool,
     return merged
 
 
+def _fast_forward_counts(opt_state, step: int):
+    """Set every ``count`` field in a (nested) optax state to ``step``
+    — the schedule-position part of resuming from a foreign (torch)
+    checkpoint that carries no optax state."""
+
+    def rec(node):
+        if hasattr(node, "_replace") and hasattr(node, "count"):
+            node = node._replace(
+                count=jnp.asarray(step, jnp.asarray(node.count).dtype)
+            )
+        if isinstance(node, tuple):
+            typ = type(node)
+            mapped = [rec(c) for c in node]
+            return typ(*mapped) if hasattr(node, "_fields") else typ(mapped)
+        return node
+
+    return rec(opt_state)
+
+
 def build_teacher(cfg: RunConfig, image_size: int):
     """Frozen FP teacher (↔ reference ``train.py:250-277``). Without a
     teacher checkpoint a TS run fails loudly — distilling from a
@@ -442,6 +461,22 @@ def fit(cfg: RunConfig) -> Dict[str, float]:
             if isinstance(raw, dict) and not cfg.reset_resume:
                 start_epoch = int(raw.get("epoch", 0))
                 best_acc1 = float(raw.get("best_acc1", 0.0))
+                # fast-forward the step counter AND every optax count so
+                # the step-indexed LR schedule resumes where the torch
+                # run left off (torch Adam moments are not translated —
+                # they restart; the schedule position must not)
+                resume_step = start_epoch * steps_per_epoch
+                state = state.replace(
+                    step=jnp.asarray(resume_step, jnp.int32),
+                    opt_state=_fast_forward_counts(
+                        state.opt_state, resume_step
+                    ),
+                )
+                logger.warning(
+                    "torch .pth resume: LR schedule fast-forwarded to "
+                    "step %d; Adam moments restart (not translated from "
+                    "torch optimizer state)", resume_step,
+                )
         else:
             restored = load_checkpoint(
                 cfg.resume, state, reset_resume=cfg.reset_resume
@@ -589,8 +624,18 @@ def _validate(eval_step, state, pipe, mesh, logger, writer, epoch):
     top5_sum = 0.0
     count = 0.0
     bs = pipe.batch_size
-    for x, y in pipe.epoch(0):
-        x, y, valid = _pad_eval_batch(np.asarray(x), np.asarray(y), bs)
+    # every host executes exactly pipe.eval_steps() collectives: hosts
+    # whose shard ran out feed fully-masked batches (valid = 0) so no
+    # host launches a collective the others never join
+    it = pipe.epoch(0)
+    for _ in range(pipe.eval_steps()):
+        try:
+            x, y = next(it)
+            x, y = np.asarray(x), np.asarray(y)
+        except StopIteration:
+            x = np.zeros((0, *pipe.image_shape), np.float32)
+            y = np.zeros((0,), np.int64)
+        x, y, valid = _pad_eval_batch(x, y, bs)
         gx, gy, gv = shard_batch(mesh, x, y, valid)
         m = eval_step(state, (gx, gy, gv))
         m = jax.device_get(m)

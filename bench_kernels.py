@@ -1,0 +1,147 @@
+"""Microbenchmark: binary-conv implementations on the attached chip.
+
+Compares, per binary-conv shape of ImageNet binary ResNet-18:
+  - dot       — XLA conv on ±1 float operands (f32 and bf16)
+  - xla_int8  — XLA conv on int8 operands, int32 accumulation
+  - pallas    — the implicit-GEMM int8 MXU kernel
+
+Run on real TPU:   python bench_kernels.py
+Run on CPU (correctness only, interpret mode): JAX_PLATFORMS=cpu ...
+
+Prints one JSON line per (shape, impl) with images/sec, then a summary
+line naming the winner — the recorded evidence for which path the
+binary convs default to (VERDICT round 1 asked for the kernel to win
+or be killed with data; see nn/kernels/binary_conv.py for the
+int8-vs-XNOR analysis).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# (name, H, W, C, O, k, stride) — the binary convs of ImageNet
+# binary ResNet-18 (stem + fc stay FP and are excluded)
+SHAPES = [
+    ("layer1 3x3", 56, 56, 64, 64, 3, 1),
+    ("layer2_ds 3x3/2", 56, 56, 64, 128, 3, 2),
+    ("layer2 3x3", 28, 28, 128, 128, 3, 1),
+    ("layer3_ds 3x3/2", 28, 28, 128, 256, 3, 2),
+    ("layer3 3x3", 14, 14, 256, 256, 3, 1),
+    ("layer4_ds 3x3/2", 14, 14, 256, 512, 3, 2),
+    ("layer4 3x3", 7, 7, 512, 512, 3, 1),
+]
+
+
+def main(batch: int = 64, iters: int = 50) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from bdbnn_tpu.nn.kernels import binary_conv2d_mxu
+    from bdbnn_tpu.nn.layers import conv2d
+
+    platform = jax.devices()[0].platform
+    interpret = platform != "tpu"
+    if interpret:
+        print(
+            f"[bench_kernels] platform={platform}: pallas runs in "
+            "interpret mode — timings are NOT meaningful, correctness only",
+            file=sys.stderr,
+        )
+        iters = 1
+
+    rng = np.random.default_rng(0)
+    results = []
+    for name, h, w, c, o, k, s in SHAPES:
+        xb = jnp.asarray(
+            np.sign(rng.normal(size=(batch, h, w, c)) + 1e-9), jnp.float32
+        )
+        wb = jnp.asarray(
+            np.sign(rng.normal(size=(k, k, c, o)) + 1e-9), jnp.float32
+        )
+        alpha = jnp.asarray(rng.uniform(0.1, 1.0, size=(o,)), jnp.float32)
+
+        impls = {
+            "dot_f32": lambda xb=xb, wb=wb: conv2d(
+                xb, wb * alpha.reshape(1, 1, 1, -1), strides=(s, s)
+            ),
+            "dot_bf16": lambda xb=xb, wb=wb: conv2d(
+                xb.astype(jnp.bfloat16),
+                (wb * alpha.reshape(1, 1, 1, -1)).astype(jnp.bfloat16),
+                strides=(s, s),
+            ),
+            "xla_int8": lambda xb=xb, wb=wb: binary_conv2d_mxu(
+                xb, wb, alpha, strides=(s, s), impl="xla_int8"
+            ),
+            "pallas": lambda xb=xb, wb=wb: binary_conv2d_mxu(
+                xb, wb, alpha, strides=(s, s), impl="pallas",
+                interpret=interpret,
+            ),
+        }
+        ref = None
+        for impl_name, fn in impls.items():
+            jf = jax.jit(fn)
+            try:
+                y = jf()
+                jax.block_until_ready(y)
+            except Exception as e:  # record and move on
+                results.append(
+                    {"shape": name, "impl": impl_name, "error": str(e)[:200]}
+                )
+                continue
+            if ref is None:
+                ref = np.asarray(y, np.float32)
+            else:
+                err = float(
+                    np.max(np.abs(np.asarray(y, np.float32) - ref))
+                )
+                if err > 1.0:  # bf16 scale rounding stays well under 1
+                    results.append(
+                        {
+                            "shape": name,
+                            "impl": impl_name,
+                            "error": f"mismatch vs f32 ref: {err}",
+                        }
+                    )
+                    continue
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                y = jf()
+            jax.block_until_ready(y)
+            dt = time.perf_counter() - t0
+            rec = {
+                "shape": name,
+                "impl": impl_name,
+                "images_per_sec": round(batch * iters / dt, 1),
+                "ms_per_call": round(1e3 * dt / iters, 3),
+            }
+            results.append(rec)
+            print(json.dumps(rec))
+
+    # summary: total time across all shapes per impl
+    totals = {}
+    for r in results:
+        if "ms_per_call" in r:
+            totals.setdefault(r["impl"], 0.0)
+            totals[r["impl"]] += r["ms_per_call"]
+    if totals:
+        winner = min(totals, key=totals.get)
+        print(
+            json.dumps(
+                {
+                    "summary": "total ms across resnet18 binary convs",
+                    "totals_ms": {k: round(v, 3) for k, v in totals.items()},
+                    "winner": winner,
+                    "platform": platform,
+                    "interpret": interpret,
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()
