@@ -36,7 +36,10 @@ class RunConfig:
     custom_resnet: bool = True
     pretrained: bool = False
     pretrained_path: str = ""  # local torch ckpt backing --pretrained
-    twoblock: bool = False  # parsed-but-unused in the reference; kept
+    # --twoblock (ref train.py:143-144, consumed in its missing models
+    # package): alternate the two binary block types (react / step2)
+    # through the net — see BiResNet.twoblock
+    twoblock: bool = False
     # schedule
     epochs: int = 90
     start_epoch: int = 0

@@ -42,7 +42,8 @@ def resolve_dtype(dtype):
     return dtype
 
 
-def _make_cifar(name, stage_sizes, width, variant, act, num_classes, dtype=None):
+def _make_cifar(name, stage_sizes, width, variant, act, num_classes,
+                dtype=None, twoblock=False):
     return BiResNet(
         stage_sizes=stage_sizes,
         num_classes=num_classes,
@@ -51,11 +52,12 @@ def _make_cifar(name, stage_sizes, width, variant, act, num_classes, dtype=None)
         variant=variant,
         act=act,
         dtype=resolve_dtype(dtype),
+        twoblock=twoblock,
     )
 
 
 def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000,
-                   pretrained=False, dtype=None):
+                   pretrained=False, dtype=None, twoblock=False):
     # ``pretrained`` accepted for reference-API parity (train.py:285-288);
     # the actual weight loading goes through create_model's caller via
     # bdbnn_tpu.models.torch_import (no network egress in this image).
@@ -68,10 +70,15 @@ def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000,
         variant=variant,
         act=act,
         dtype=resolve_dtype(dtype),
+        twoblock=twoblock,
     )
 
 
-def _make_vgg(num_classes, variant="cifar", dtype=None):
+def _make_vgg(num_classes, variant="cifar", dtype=None, twoblock=False):
+    if twoblock:
+        raise ValueError(
+            "--twoblock mixes ResNet block types; vgg_small has no blocks"
+        )
     return VGGSmallBinary(
         num_classes=num_classes, variant=variant, dtype=resolve_dtype(dtype)
     )

@@ -211,6 +211,15 @@ class BiResNet(nn.Module):
     variant: str = "react"  # react | step2 | cifar | float
     act: str = "rprelu"  # rprelu | hardtanh | identity
     dtype: Any = None  # compute dtype (e.g. jnp.bfloat16); params stay f32
+    # --twoblock (reference train.py:143-144, consumed inside its missing
+    # models package): mix TWO block types through the net — odd-position
+    # blocks swap to the partner binary variant (react <-> step2; the two
+    # binary-conv families the reference imports at train.py:30-31), with
+    # the partner's matching activation. float twins ignore it.
+    twoblock: bool = False
+
+    _TWOBLOCK_PARTNER = {"react": "step2", "step2": "react", "cifar": "react"}
+    _VARIANT_ACT = {"react": "rprelu", "step2": "hardtanh", "cifar": "hardtanh"}
 
     @nn.compact
     def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
@@ -238,18 +247,24 @@ class BiResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem: {self.stem!r}")
 
+        block_idx = 0
         for s, num_blocks in enumerate(self.stage_sizes):
             features = self.width * (2**s)
             for b in range(num_blocks):
                 strides = 2 if (s > 0 and b == 0) else 1
+                variant, act = self.variant, self.act
+                if self.twoblock and variant != "float" and block_idx % 2 == 1:
+                    variant = self._TWOBLOCK_PARTNER[variant]
+                    act = self._VARIANT_ACT[variant]
                 x = BiBasicBlock(
                     features=features,
                     strides=strides,
-                    variant=self.variant,
-                    act=self.act,
+                    variant=variant,
+                    act=act,
                     dtype=self.dtype,
                     name=f"layer{s + 1}_{b}",
                 )(x, train=train, tk=tk)
+                block_idx += 1
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)

@@ -118,6 +118,10 @@ def make_train_step(
         logits = aux.pop("logits")
         metrics = {
             **aux,
+            # example-weighted sum: epoch means must weight each step by
+            # its example count, not average per-step means (which skews
+            # when the final print interval is shorter — VERDICT r3 #6)
+            "loss_sum": aux["loss"] * labels.shape[0],
             **topk_correct(logits, labels),
             "count": jnp.int32(labels.shape[0]),
         }
@@ -198,6 +202,7 @@ def make_ts_train_step(
         logits = aux.pop("logits")
         metrics = {
             **aux,
+            "loss_sum": aux["loss"] * labels.shape[0],
             **topk_correct(logits, labels),
             "count": jnp.int32(labels.shape[0]),
         }

@@ -4,24 +4,41 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Metric: images/sec/chip for the full BD-BNN training step (forward +
 backward + optimizer + kurtosis regularization) on binary ResNet-18 at
-224×224 in bf16 — the workload of BASELINE config 3 ("ResNet-18 BD-BNN,
-ImageNet, single-chip, kurtosis reg only"). The f32 rate is reported
-alongside so the bf16 speedup is visible.
+224x224 in bf16 — the workload of BASELINE config 3 ("ResNet-18 BD-BNN,
+ImageNet, single-chip, kurtosis reg only"). Reference anchor for the
+loop being benchmarked: ``/root/reference/train.py:441-554``.
+
+Measurement methodology (round 4 — defensibility fixes):
+
+* **Fenced windows.** Async dispatch through remote PJRT tunnels can
+  return from ``block_until_ready`` before execution completes, which
+  inflated round 3's headline ~13x (95,975 img/s ≈ 1.05 PFLOP/s —
+  above the bf16 peak of any TPU through v6e). Each timing window now
+  ends with a device-to-host transfer of the final loss (a true fence);
+  the headline is the median over several windows.
+* **Analytic FLOPs + MFU.** The compiled step's FLOPs come from XLA's
+  own ``compiled.cost_analysis()``; MFU is computed against the chip's
+  published bf16 peak (table below). ``timing_suspect`` is set when
+  MFU exceeds 100% — such a number must not be trusted.
+* **Profiler trace.** When ``BDBNN_BENCH_PROFILE_DIR`` is set (or
+  ``--profile-dir`` passed), a ``jax.profiler`` trace of 5 steps is
+  captured and the median on-device ``jit_train_step`` duration is
+  reported as ``device_ms_per_step`` (the tunnel-latency-free number).
 
 Robustness: the measurement runs in a SUBPROCESS with a hard timeout —
-a hung or unavailable TPU backend (remote PJRT plugins can block in
-backend init) is killed and retried with backoff; after the final
-attempt a parseable JSON error line is printed instead of a traceback.
+a hung or unavailable TPU backend is killed and retried with backoff;
+after the final attempt a parseable JSON error line is printed instead
+of a traceback.
 
 Baseline provenance: the reference repo publishes no throughput numbers
 (SURVEY.md §6) and this container has no network egress, so
 ``vs_baseline`` normalizes against a pinned engineering estimate of the
 reference's per-GPU rate for this exact step: ~900 images/sec — binary
-ResNet-18 with FP latent weights trains at FP32 ResNet-18 speed on
-GPUs (stock cuDNN convs, no 1-bit path; reference ``train.py:9-19``),
-and FP32 ResNet-18 ImageNet training sits in the 700–1100 img/s range
-on A100/H100-class parts. Override with env BDBNN_BENCH_BASELINE when a
-measured anchor exists. The north star (BASELINE.json) is ≥1.5×
+ResNet-18 with FP latent weights trains at FP32 ResNet-18 speed on GPUs
+(stock cuDNN convs, no 1-bit path; reference ``train.py:9-19``), and
+FP32 ResNet-18 ImageNet training sits in the 700–1100 img/s range on
+A100/H100-class parts. Override with env BDBNN_BENCH_BASELINE when a
+measured anchor exists. The north star (BASELINE.json) is ≥1.5x
 chip-normalized.
 """
 
@@ -40,9 +57,24 @@ BASELINE_IMAGES_PER_SEC_PER_CHIP = float(
 METRIC = "train_step_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
 
+# Published per-chip dense bf16 peaks (TFLOP/s). Keyed on
+# jax.devices()[0].device_kind. Sources: Google Cloud TPU system
+# architecture docs (v2-v6e product pages).
+BF16_PEAK_TFLOPS = {
+    "TPU v2": 22.5,
+    "TPU v3": 61.5,
+    "TPU v4": 275.0,  # one megacore device per chip
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,       # v5p reports device_kind "TPU v5"
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,  # v6e (Trillium)
+    "TPU v6e": 918.0,
+}
 
-def _measure(dtype: str, batch: int, iters: int) -> float:
-    """Images/sec for the jitted flagship train step at ``dtype``."""
+
+def _build_step(dtype: str, batch: int):
+    """The flagship jitted train step + inputs (BASELINE config 3)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -80,23 +112,108 @@ def _measure(dtype: str, batch: int, iters: int) -> float:
     )
     state = TrainState.create(variables, tx)
     step = jax.jit(make_train_step(model, tx, cfg), donate_argnums=(0,))
-
     tk = (jnp.float32(1.0), jnp.float32(1.0))
     gate = jnp.float32(1.0)
+    return step, state, (x, y), tk, gate
 
-    # warmup / compile + 2 steady steps
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+_T0 = time.perf_counter()
+
+
+def _compile_step(dtype: str, batch: int):
+    """AOT-compile the flagship step ONCE (jit dispatch would compile a
+    second cache entry; compiles are the slow part over a remote
+    tunnel). Returns (compiled, state, args..., flops)."""
+    _log(f"building step dtype={dtype}")
+    step, state, batch_xy, tk, gate = _build_step(dtype, batch)
+    _log("lowering + compiling")
+    compiled = step.lower(state, batch_xy, tk, gate).compile()
+    _log("compiled")
+    try:
+        flops = float(compiled.cost_analysis().get("flops", 0.0))
+    except Exception:
+        flops = 0.0
+    _log(f"cost_analysis flops={flops:.3e}")
+    return compiled, state, batch_xy, tk, gate, flops
+
+
+def _measure_compiled(compiled, state, batch_xy, tk, gate, batch: int,
+                      iters: int, windows: int = 5):
+    """Median fenced-window images/sec for a compiled step.
+
+    Every window of ``iters`` chained steps ends with a device-to-host
+    transfer of the loss — the only fence observed to be reliable over
+    remote PJRT tunnels (``block_until_ready`` alone returned early and
+    inflated round-3 numbers ~13x).
+    """
+    metrics = None
     for _ in range(3):
-        state, metrics = step(state, (x, y), tk, gate)
-    jax.block_until_ready(metrics["loss"])
-    print(f"[bench] {dtype}: compiled, timing {iters} steps", file=sys.stderr)
+        state, metrics = compiled(state, batch_xy, tk, gate)
+    loss = float(metrics["loss"])  # fence
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, (x, y), tk, gate)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
-    assert bool(jnp.isfinite(metrics["loss"])), "non-finite loss in bench"
-    return batch * iters / dt
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        s, m = state, metrics
+        for _ in range(iters):
+            s, m = compiled(s, batch_xy, tk, gate)
+        loss = float(m["loss"])  # fence: true device-to-host transfer
+        dt = time.perf_counter() - t0
+        rates.append(iters * batch / dt)
+        state = s
+    import math
+
+    assert math.isfinite(loss), f"non-finite loss in bench: {loss}"
+    rates.sort()
+    return rates[len(rates) // 2], state
+
+
+def _profile_device_ms(compiled, state, batch_xy, tk, gate, batch: int,
+                       profile_dir: str):
+    """Trace 5 steps of the already-compiled step; return median
+    on-device jit_train_step ms."""
+    import glob
+    import gzip
+
+    import jax
+
+    os.makedirs(profile_dir, exist_ok=True)
+    with jax.profiler.trace(profile_dir):
+        s, m = state, None
+        for _ in range(5):
+            s, m = compiled(s, batch_xy, tk, gate)
+        _ = float(m["loss"])
+
+    traces = sorted(
+        glob.glob(os.path.join(profile_dir, "plugins/profile/*/*.trace.json.gz"))
+    )
+    if not traces:
+        return None, None, s
+    with gzip.open(traces[-1]) as f:
+        tr = json.load(f)
+    events = tr.get("traceEvents", [])
+    pids = {
+        e["pid"]: e["args"].get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    device_pids = {p for p, n in pids.items() if "TPU" in n or "device" in n.lower()}
+    durs = [
+        e["dur"] / 1e3
+        for e in events
+        if e.get("ph") == "X"
+        and e.get("pid") in device_pids
+        and str(e.get("name", "")).startswith("jit_train_step")
+    ]
+    if not durs:
+        return None, traces[-1], s
+    durs.sort()
+    return durs[len(durs) // 2], traces[-1], s
 
 
 def worker_main(args) -> None:
@@ -110,17 +227,23 @@ def worker_main(args) -> None:
     from bdbnn_tpu.nn.kernels import default_impl
 
     n_chips = max(jax.device_count(), 1)
+    dev = jax.devices()[0]
+    device_kind = dev.device_kind
+    peak_tflops = BF16_PEAK_TFLOPS.get(device_kind)
     print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
 
     # Staged measurement, emitting a cumulative JSON line after every
     # stage: if the driver's timeout kills us mid-way, the parent still
-    # scavenges the last complete line. Stage 1 (bf16 + stock XLA conv)
-    # is the safe headline; the f32 comparison and the int8 MXU paths
-    # (see nn/kernels/binary_conv.py) enrich it — the best successful
-    # rate becomes the headline and "conv_impl" records the winner.
+    # scavenges the last complete line.
     rates = {}
-    extras = {"batch": args.batch, "n_chips": n_chips,
-              "platform": jax.devices()[0].platform}
+    extras = {
+        "batch": args.batch,
+        "n_chips": n_chips,
+        "platform": dev.platform,
+        "device_kind": device_kind,
+        "bf16_peak_tflops": peak_tflops,
+        "fencing": "device-to-host loss transfer per window, median of windows",
+    }
 
     def emit():
         best = max(rates, key=rates.get)
@@ -136,15 +259,57 @@ def worker_main(args) -> None:
             "impl_rates": {k: round(v, 2) for k, v in rates.items()},
             **extras,
         }
+        if peak_tflops and extras.get("flops_per_step"):
+            per_image = extras["flops_per_step"] / args.batch
+            achieved = per_image * rates[best]
+            out["achieved_tflops"] = round(achieved / 1e12, 2)
+            out["mfu"] = round(achieved / (peak_tflops * 1e12), 4)
+            out["timing_suspect"] = bool(out["mfu"] > 1.0)
         print(json.dumps(out), flush=True)
 
     with default_impl("dot"):
-        rates["dot"] = _measure("bfloat16", args.batch, args.iters) / n_chips
+        compiled, state, batch_xy, tk, gate, flops = _compile_step(
+            "bfloat16", args.batch
+        )
+        rate, state = _measure_compiled(
+            compiled, state, batch_xy, tk, gate, args.batch, args.iters
+        )
+        rates["dot"] = rate / n_chips
+        extras["flops_per_step"] = flops
+        extras["gflops_per_image"] = round(flops / args.batch / 1e9, 3)
     emit()
+
+    if args.profile_dir:
+        try:
+            dev_ms, trace_path, state = _profile_device_ms(
+                compiled, state, batch_xy, tk, gate, args.batch,
+                args.profile_dir,
+            )
+            if dev_ms:
+                extras["device_ms_per_step"] = round(dev_ms, 3)
+                extras["device_images_per_sec"] = round(
+                    args.batch / (dev_ms / 1e3), 2
+                )
+                if peak_tflops and extras.get("flops_per_step"):
+                    extras["device_mfu"] = round(
+                        extras["flops_per_step"]
+                        / (dev_ms / 1e3)
+                        / (peak_tflops * 1e12),
+                        4,
+                    )
+            if trace_path:
+                extras["profile_trace"] = trace_path
+            emit()
+        except Exception as e:
+            print(f"[bench] profiling failed: {e}", file=sys.stderr)
 
     if args.compare:
         with default_impl("dot"):
-            f32 = _measure("float32", args.batch, args.iters) / n_chips
+            c2, s2, bxy2, tk2, g2, _ = _compile_step("float32", args.batch)
+            f32, _ = _measure_compiled(
+                c2, s2, bxy2, tk2, g2, args.batch, args.iters
+            )
+        f32 /= n_chips
         extras["f32_images_per_sec_per_chip"] = round(f32, 2)
         extras["bf16_speedup_vs_f32"] = round(rates["dot"] / f32, 3)
         emit()
@@ -152,9 +317,13 @@ def worker_main(args) -> None:
     for impl in ("xla_int8", "pallas") if args.try_int8 else ():
         try:
             with default_impl(impl):
-                rates[impl] = (
-                    _measure("bfloat16", args.batch, args.iters) / n_chips
+                ci, si, bxyi, tki, gi, _ = _compile_step(
+                    "bfloat16", args.batch
                 )
+                r, _ = _measure_compiled(
+                    ci, si, bxyi, tki, gi, args.batch, args.iters
+                )
+                rates[impl] = r / n_chips
             emit()
         except Exception as e:
             print(f"[bench] impl {impl} failed: {e}", file=sys.stderr)
@@ -164,9 +333,14 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--batch", type=int, default=128)
-    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--attempts", type=int, default=2)
     ap.add_argument("--timeout", type=float, default=540.0)
+    ap.add_argument(
+        "--profile-dir",
+        default=os.environ.get("BDBNN_BENCH_PROFILE_DIR", ""),
+        help="capture a jax.profiler trace here (empty = skip)",
+    )
     ap.add_argument("--no-compare", dest="compare", action="store_false",
                     help="skip the f32 comparison run")
     ap.add_argument("--no-int8", dest="try_int8", action="store_false",
@@ -183,6 +357,8 @@ def main() -> None:
             sys.executable, os.path.abspath(__file__), "--worker",
             "--batch", str(args.batch), "--iters", str(args.iters),
         ]
+        if args.profile_dir:
+            cmd += ["--profile-dir", args.profile_dir]
         if not args.compare:
             cmd.append("--no-compare")
         if not args.try_int8:

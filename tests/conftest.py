@@ -30,6 +30,21 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The `fast` tier (`pytest -m fast`, <60s): pure-numerics oracle tests —
+# binarization custom_vjps, kurtosis/KD losses, optimizer + EDE-schedule
+# torch parity. The full suite stays the default.
+_FAST_MODULES = {"test_binarize", "test_kurtosis", "test_kd"}
+_FAST_CLASSES = {"TestOptimizerParity", "TestEDESchedule"}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if (
+            item.module.__name__ in _FAST_MODULES
+            or (item.cls is not None and item.cls.__name__ in _FAST_CLASSES)
+        ):
+            item.add_marker(pytest.mark.fast)
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,83 @@
+"""The recipe LEARNS: synthetic-memorization to >90% train accuracy.
+
+VERDICT r3 task 5 — the strongest in-suite convergence evidence so far
+was "loss decreases over a few steps"; this pins the full BD-BNN recipe
+(binary convs + STE/EDE + kurtosis regularization, reference
+``train.py:441-554`` + ``utils/utils.py:6-14``) actually fitting data,
+and that bf16 training tracks f32 within tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bdbnn_tpu.models import conv_weight_paths
+from bdbnn_tpu.models.resnet import BiResNet
+from bdbnn_tpu.train import (
+    StepConfig,
+    TrainState,
+    cpt_tk,
+    make_optimizer,
+    make_train_step,
+)
+
+N, HW, CLASSES = 32, 8, 4
+STEPS = 300
+EPOCHS_FAKE = 12  # EDE schedule length; one "epoch" per 25 steps
+
+
+def _data():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(N, HW, HW, 3)).astype(np.float32)
+    y = rng.integers(0, CLASSES, size=(N,))
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _train(dtype):
+    model = BiResNet(
+        stage_sizes=(1, 1), num_classes=CLASSES, width=16,
+        stem="cifar", variant="cifar", act="hardtanh",
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else None,
+    )
+    x, y = _data()
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    paths = conv_weight_paths(variables["params"])
+    hooked = tuple(paths[1:])
+    cfg = StepConfig(
+        w_kurtosis=True,
+        kurt_paths=hooked,
+        kurt_targets=(1.8,) * len(hooked),
+        kurtosis_mode="avg",
+        w_lambda_kurtosis=0.1,
+        ede=True,
+    )
+    tx = make_optimizer(
+        variables["params"], dataset="cifar10", lr=0.05,
+        epochs=EPOCHS_FAKE, steps_per_epoch=STEPS // EPOCHS_FAKE,
+    )
+    state = TrainState.create(variables, tx)
+    step = jax.jit(make_train_step(model, tx, cfg), donate_argnums=(0,))
+
+    accs = []
+    for i in range(STEPS):
+        epoch = i // (STEPS // EPOCHS_FAKE)
+        t, k = cpt_tk(epoch, EPOCHS_FAKE)
+        tk = (jnp.float32(t), jnp.float32(k))
+        state, m = step(state, (x, y), tk, jnp.float32(1.0))
+        accs.append(float(m["top1"]) / N)
+    assert np.isfinite(float(m["loss"]))
+    return accs
+
+
+class TestMemorization:
+    def test_recipe_memorizes_to_90pct_and_bf16_tracks_f32(self):
+        acc_f32 = _train("float32")
+        assert max(acc_f32[-20:]) > 0.90, (
+            f"f32 failed to memorize: last-20 accs {acc_f32[-20:]}"
+        )
+        acc_bf16 = _train("bfloat16")
+        assert max(acc_bf16[-20:]) > 0.85, (
+            f"bf16 failed to track f32 ({max(acc_f32[-20:]):.2f}): "
+            f"last-20 accs {acc_bf16[-20:]}"
+        )

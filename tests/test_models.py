@@ -234,3 +234,45 @@ def test_registry_lists_and_rejects():
         create_model("resnet999", "cifar10")
     with pytest.raises(ValueError):
         create_model("resnet18", "mnist")
+
+
+class TestTwoBlock:
+    """--twoblock (ref train.py:143-144): odd blocks swap to the partner
+    binary variant — react blocks carry RPReLU/shift params, step2
+    blocks don't."""
+
+    def _block_kinds(self, params):
+        kinds = {}
+        for name, sub in params.items():
+            if not name.startswith("layer"):
+                continue
+            has_react = any("act1" in k or "shift" in k for k in sub)
+            kinds[name] = "react" if has_react else "plain"
+        return kinds
+
+    def test_alternates_block_types(self):
+        model = create_model("resnet18", "imagenet", twoblock=True)
+        variables = _init(model, 32, train=False)
+        kinds = self._block_kinds(variables["params"])
+        # 8 blocks: even positions react (imagenet default), odd step2
+        order = sorted(kinds, key=lambda n: (int(n[5]), int(n[7:])))
+        expected = ["react" if i % 2 == 0 else "plain" for i in range(8)]
+        assert [kinds[n] for n in order] == expected, kinds
+
+    def test_same_conv_inventory_and_forward(self):
+        model = create_model("resnet18", "imagenet", twoblock=True)
+        variables = _init(model, 64, train=False)
+        # the 20-conv / 19-hooked flagship constraint is variant-blind
+        assert len(conv_weight_paths(variables["params"])) == 20
+        out = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+        assert out.shape == (2, 1000)
+
+    def test_float_twin_ignores_twoblock(self):
+        a = create_model("resnet18_float", "cifar10")
+        b = create_model("resnet18_float", "cifar10", twoblock=True)
+        va, vb = _init(a, 32), _init(b, 32)
+        assert jax.tree_util.tree_structure(va) == jax.tree_util.tree_structure(vb)
+
+    def test_vgg_rejects_twoblock(self):
+        with pytest.raises(ValueError):
+            create_model("vgg_small", "cifar10", twoblock=True)

@@ -233,6 +233,73 @@ class TestTrainStep:
         assert int(metrics["count"]) == 16
         assert 0 <= int(metrics["top1"]) <= int(metrics["top5"]) <= 16
 
+    def test_epoch_mean_is_example_weighted(self):
+        """VERDICT r3 #6 regression: the step emits loss_sum = loss x
+        count so interval/epoch means are exact example-weighted means
+        even when drain intervals are unequal."""
+        from bdbnn_tpu.utils import DeviceMetrics, Mean
+
+        _, state, step, batch = self._setup()
+        tk = jnp.float32(1.0), jnp.float32(1.0)
+        per_step = []  # (loss, count)
+        devmet = DeviceMetrics()
+        mean = Mean("Loss")
+        n_steps = 7
+        for i in range(n_steps):
+            state, metrics = step(state, batch, tk, jnp.float32(0.0))
+            assert float(metrics["loss_sum"]) == pytest.approx(
+                float(metrics["loss"]) * int(metrics["count"]), rel=1e-6
+            )
+            per_step.append((float(metrics["loss"]), int(metrics["count"])))
+            devmet.add(metrics)
+            # unequal intervals: drain after steps 0, 4, 6
+            if i in (0, 4, n_steps - 1):
+                sums = devmet.drain()
+                n = max(sums["count"], 1.0)
+                mean.add(sums["loss_sum"] / n, n)
+        exact = sum(l * c for l, c in per_step) / sum(c for _, c in per_step)
+        assert mean.mean == pytest.approx(exact, rel=1e-6)
+
+
+class TestFastForwardCounts:
+    """VERDICT r3 #9 / ADVICE r2: counts inside dict-based optax states
+    (e.g. inject_hyperparams) must fast-forward on torch .pth resume."""
+
+    def test_namedtuple_and_dict_counts(self):
+        from bdbnn_tpu.train.loop import _fast_forward_counts
+
+        import optax
+
+        # real dict-carrying optax state
+        tx = optax.inject_hyperparams(optax.adamw)(learning_rate=1e-3)
+        params = {"w": jnp.ones((3, 3))}
+        st = tx.init(params)
+        ff = _fast_forward_counts(st, 123)
+
+        counts = []
+
+        def collect(node):
+            if "count" in getattr(node, "_fields", ()):
+                counts.append(int(node.count))
+            if isinstance(node, tuple):
+                for c in node:
+                    collect(c)
+            elif isinstance(node, dict):
+                for k, v in node.items():
+                    if k == "count" and not isinstance(v, (dict, tuple)):
+                        counts.append(int(v))
+                    else:
+                        collect(v)
+
+        collect(ff)
+        assert counts and all(c == 123 for c in counts), counts
+
+        # synthetic pure-dict state (the ADVICE scenario verbatim)
+        st2 = {"inner": {"count": jnp.int32(0), "mu": jnp.zeros(2)}}
+        ff2 = _fast_forward_counts(st2, 77)
+        assert int(ff2["inner"]["count"]) == 77
+        assert float(ff2["inner"]["mu"][0]) == 0.0
+
 
 class TestTSStep:
     def test_react_vs_full_loss_wiring(self):
