@@ -12,6 +12,7 @@ from bdbnn_tpu.data.datasets import (
 )
 from bdbnn_tpu.data.pipeline import (
     ImageFolderPipeline,
+    MPImageFolderPipeline,
     Pipeline,
     cifar_eval_transform,
     cifar_train_augment,
@@ -32,6 +33,7 @@ __all__ = [
     "load_cifar100",
     "synthetic_dataset",
     "ImageFolderPipeline",
+    "MPImageFolderPipeline",
     "Pipeline",
     "cifar_eval_transform",
     "cifar_train_augment",

@@ -34,6 +34,7 @@ from bdbnn_tpu.configs.config import RunConfig
 from bdbnn_tpu.data import (
     ImageFolder,
     ImageFolderPipeline,
+    MPImageFolderPipeline,
     Pipeline,
     load_cifar10,
     load_cifar100,
@@ -147,23 +148,30 @@ def build_datasets(cfg: RunConfig):
         return mk(train_ds, True), mk(val_ds, False), image_size
 
     try:
-        train_pipe = ImageFolderPipeline(
-            ImageFolder(os.path.join(cfg.data, "train")),
-            per_host_batch,
-            train=True,
-            seed=cfg.seed or 0,
-            host_id=host_id,
-            num_hosts=num_hosts,
-            num_threads=cfg.workers,
-        )
-        val_pipe = ImageFolderPipeline(
-            ImageFolder(os.path.join(cfg.data, "val")),
-            per_host_batch,
-            train=False,
-            host_id=host_id,
-            num_hosts=num_hosts,
-            num_threads=cfg.workers,
-        )
+        # worker PROCESSES (↔ the reference's 16 DataLoader workers,
+        # loader.py:83); --workers 0 falls back to the in-process
+        # thread pipeline (tests, debugging)
+        if cfg.workers > 0:
+            mk_folder = lambda split, train: MPImageFolderPipeline(
+                ImageFolder(os.path.join(cfg.data, split)),
+                per_host_batch,
+                train=train,
+                seed=cfg.seed or 0,
+                host_id=host_id,
+                num_hosts=num_hosts,
+                num_workers=cfg.workers,
+            )
+        else:
+            mk_folder = lambda split, train: ImageFolderPipeline(
+                ImageFolder(os.path.join(cfg.data, split)),
+                per_host_batch,
+                train=train,
+                seed=cfg.seed or 0,
+                host_id=host_id,
+                num_hosts=num_hosts,
+            )
+        train_pipe = mk_folder("train", True)
+        val_pipe = mk_folder("val", False)
     except (FileNotFoundError, OSError) as e:
         raise FileNotFoundError(
             f"imagenet data not found under {cfg.data!r} ({e}); "

@@ -245,6 +245,8 @@ def worker_main(args) -> None:
         "fencing": "device-to-host loss transfer per window, median of windows",
     }
 
+    flops_by_impl = {}
+
     def emit():
         best = max(rates, key=rates.get)
         out = {
@@ -259,8 +261,10 @@ def worker_main(args) -> None:
             "impl_rates": {k: round(v, 2) for k, v in rates.items()},
             **extras,
         }
-        if peak_tflops and extras.get("flops_per_step"):
-            per_image = extras["flops_per_step"] / args.batch
+        # MFU must pair the winning impl's rate with ITS OWN compiled
+        # step's FLOPs — impls lower differently
+        if peak_tflops and flops_by_impl.get(best):
+            per_image = flops_by_impl[best] / args.batch
             achieved = per_image * rates[best]
             out["achieved_tflops"] = round(achieved / 1e12, 2)
             out["mfu"] = round(achieved / (peak_tflops * 1e12), 4)
@@ -275,6 +279,7 @@ def worker_main(args) -> None:
             compiled, state, batch_xy, tk, gate, args.batch, args.iters
         )
         rates["dot"] = rate / n_chips
+        flops_by_impl["dot"] = flops
         extras["flops_per_step"] = flops
         extras["gflops_per_image"] = round(flops / args.batch / 1e9, 3)
     emit()
@@ -317,13 +322,14 @@ def worker_main(args) -> None:
     for impl in ("xla_int8", "pallas") if args.try_int8 else ():
         try:
             with default_impl(impl):
-                ci, si, bxyi, tki, gi, _ = _compile_step(
+                ci, si, bxyi, tki, gi, fi = _compile_step(
                     "bfloat16", args.batch
                 )
                 r, _ = _measure_compiled(
                     ci, si, bxyi, tki, gi, args.batch, args.iters
                 )
                 rates[impl] = r / n_chips
+                flops_by_impl[impl] = fi
             emit()
         except Exception as e:
             print(f"[bench] impl {impl} failed: {e}", file=sys.stderr)
@@ -338,8 +344,9 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=540.0)
     ap.add_argument(
         "--profile-dir",
-        default=os.environ.get("BDBNN_BENCH_PROFILE_DIR", ""),
-        help="capture a jax.profiler trace here (empty = skip)",
+        default=os.environ.get("BDBNN_BENCH_PROFILE_DIR", "profiles/bench"),
+        help="capture a jax.profiler trace here ('' = skip); the trace "
+        "backs the reported device_ms_per_step / device_mfu",
     )
     ap.add_argument("--no-compare", dest="compare", action="store_false",
                     help="skip the f32 comparison run")

@@ -37,8 +37,16 @@ SHAPES = [
 ]
 
 
-def main(batch: int = 64, iters: int = 50) -> None:
+def main(batch: int = 64, iters: int = 20, out_path: str = "") -> None:
+    import os
+
     import jax
+
+    # explicit JAX_PLATFORMS must win over a PJRT-plugin sitecustomize's
+    # jax.config.update (same guard as bench.py / tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     import jax.numpy as jnp
 
     from bdbnn_tpu.nn.kernels import binary_conv2d_mxu
@@ -108,16 +116,28 @@ def main(batch: int = 64, iters: int = 50) -> None:
                         }
                     )
                     continue
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                y = jf()
-            jax.block_until_ready(y)
-            dt = time.perf_counter() - t0
+            # median of fenced windows: each window ends with a scalar
+            # device-to-host fetch — a true fence. block_until_ready
+            # alone returned early over the remote PJRT tunnel and
+            # produced round-3's impossible headline (see bench.py);
+            # single-device streams execute in dispatch order, so the
+            # last result's transfer implies all prior calls finished.
+            window_ms = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    y = jf()
+                _ = float(y[0, 0, 0, 0])
+                window_ms.append(
+                    1e3 * (time.perf_counter() - t0) / iters
+                )
+            window_ms.sort()
+            ms = window_ms[len(window_ms) // 2]
             rec = {
                 "shape": name,
                 "impl": impl_name,
-                "images_per_sec": round(batch * iters / dt, 1),
-                "ms_per_call": round(1e3 * dt / iters, 3),
+                "images_per_sec": round(batch * 1e3 / ms, 1),
+                "ms_per_call": round(ms, 3),
             }
             results.append(rec)
             print(json.dumps(rec))
@@ -128,20 +148,28 @@ def main(batch: int = 64, iters: int = 50) -> None:
         if "ms_per_call" in r:
             totals.setdefault(r["impl"], 0.0)
             totals[r["impl"]] += r["ms_per_call"]
-    if totals:
-        winner = min(totals, key=totals.get)
-        print(
-            json.dumps(
-                {
-                    "summary": "total ms across resnet18 binary convs",
-                    "totals_ms": {k: round(v, 3) for k, v in totals.items()},
-                    "winner": winner,
-                    "platform": platform,
-                    "interpret": interpret,
-                }
-            )
-        )
+    summary = {
+        "summary": "total ms across resnet18 binary convs",
+        "totals_ms": {k: round(v, 3) for k, v in totals.items()},
+        "winner": min(totals, key=totals.get) if totals else None,
+        "platform": platform,
+        "interpret": interpret,
+        "batch": batch,
+        "fencing": "scalar D2H fetch per window, median of 5 windows",
+        "results": results,
+    }
+    print(json.dumps(summary))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=2)
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--out", default="", help="also write summary JSON here")
+    a = ap.parse_args()
+    main(batch=a.batch, iters=a.iters, out_path=a.out)

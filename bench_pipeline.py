@@ -1,0 +1,134 @@
+"""Standalone input-pipeline benchmark (no model): images/sec of the
+ImageNet-style decode+augment feed.
+
+VERDICT r3 task 4: the training chip sustains ~7.5k img/s on the
+flagship step (profiles/r04/PROFILE_r04.json), so the input pipeline —
+not the chip — is the binding constraint unless it scales past that.
+This measures the thread fallback vs the multiprocess pipeline
+(MPImageFolderPipeline) on a generated JPEG ImageFolder and writes
+PIPELINE_r04.json with per-worker scaling + the host-core count needed
+to saturate the measured device rate. Reference anchor: 16 DataLoader
+worker processes, ``loader.py:83``.
+
+Usage: python bench_pipeline.py [--out PIPELINE_r04.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import tempfile
+import time
+
+import numpy as np
+
+DEVICE_IMG_PER_SEC = 7533.0  # profiles/r04: device-side flagship step rate
+
+
+def make_jpeg_folder(root: str, n_images: int = 384, hw: int = 256) -> str:
+    """Synthetic JPEG ImageFolder: realistic decode cost (DCT + huffman
+    of photographic-entropy content), no dataset download needed."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for cls in range(2):
+        d = os.path.join(root, "train", f"class{cls}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_images // 2):
+            # smooth low-frequency content + noise ≈ photographic entropy
+            base = rng.normal(size=(hw // 8, hw // 8, 3))
+            up = np.kron(base, np.ones((8, 8, 1)))
+            img = np.clip(
+                (up * 40 + 128 + rng.normal(scale=12, size=up.shape)), 0, 255
+            ).astype(np.uint8)
+            Image.fromarray(img).save(
+                os.path.join(d, f"{i:05d}.jpg"), quality=90
+            )
+    return os.path.join(root, "train")
+
+
+def measure(pipe, n_batches: int) -> float:
+    it = pipe.epoch(0)
+    # warm one batch (pool spin-up / first-decode costs out of the timing)
+    next(it)
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(n_batches):
+        x, y = next(it)
+        n += len(y)
+    dt = time.perf_counter() - t0
+    it.close()  # release the generator; pool cleanup is the pipeline's
+    if hasattr(pipe, "close"):
+        pipe.close()
+    return n / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="PIPELINE_r04.json")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--images", type=int, default=384)
+    args = ap.parse_args()
+
+    from bdbnn_tpu.data import (
+        ImageFolder,
+        ImageFolderPipeline,
+        MPImageFolderPipeline,
+    )
+
+    ncpu = multiprocessing.cpu_count()
+    out = {
+        "what": (
+            "input-pipeline-only throughput (no model): JPEG decode + "
+            "RandomResizedCrop(224) + hflip + normalize, ImageNet-style"
+        ),
+        "host_cpu_count": ncpu,
+        "batch_size": args.batch,
+        "threads_img_per_sec": {},
+        "processes_img_per_sec": {},
+        "device_img_per_sec_target": DEVICE_IMG_PER_SEC,
+    }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        folder = ImageFolder(make_jpeg_folder(tmp, n_images=args.images))
+
+        for workers in (1, 2, 4):
+            pipe = ImageFolderPipeline(
+                folder, args.batch, train=True, num_threads=workers
+            )
+            rate = measure(pipe, args.batches)
+            out["threads_img_per_sec"][str(workers)] = round(rate, 1)
+            print(f"threads={workers}: {rate:8.1f} img/s", flush=True)
+
+        for workers in (1, 2, 4, 8):
+            pipe = MPImageFolderPipeline(
+                folder, args.batch, train=True, num_workers=workers
+            )
+            rate = measure(pipe, args.batches)
+            out["processes_img_per_sec"][str(workers)] = round(rate, 1)
+            print(f"processes={workers}: {rate:8.1f} img/s", flush=True)
+
+    best_1w = out["processes_img_per_sec"].get("1", 1.0)
+    out["per_worker_img_per_sec"] = best_1w
+    out["workers_to_saturate_device"] = int(
+        np.ceil(DEVICE_IMG_PER_SEC / max(best_1w, 1e-9))
+    )
+    out["note"] = (
+        f"this container exposes {ncpu} CPU core(s), so absolute rates "
+        "here are per-core floor measurements, not pod-host capability; "
+        "a v5e pod host (100+ vCPUs) running "
+        f"~{out['workers_to_saturate_device']} workers of the measured "
+        "per-worker rate saturates the device step rate. The process "
+        "pipeline exists because the thread fallback is GIL-bound and "
+        "cannot scale past ~1 core regardless of host size."
+    )
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

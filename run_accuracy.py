@@ -1,0 +1,160 @@
+"""Real-data accuracy run → ACCURACY_r04.json (VERDICT r3 task 2).
+
+Data reality of this container: the CIFAR-10 binaries are NOT present
+anywhere on disk and the image has zero network egress, so the closest
+real dataset is sklearn's bundled `digits` (1,797 genuine handwritten
+8x8 digit images, 10 classes — shipped inside scikit-learn, no
+download). This script repackages digits as a CIFAR-layout ``data.npz``
+(nearest-upsample 8x8→32x32, 0-16 → 0-255 uint8, 3 channels) so the
+UNMODIFIED CIFAR-10 training path — ``fit()`` with BASELINE config 1's
+recipe (binary ResNet-20, kurtosis regularizer, EDE, SGD+cosine, no
+KD; reference ``train.py:441-554``) — trains on real data end-to-end:
+real pipeline, real augmentation, real validation, real checkpoints.
+
+Writes ACCURACY_r04.json with the full per-epoch top-1 curve pulled
+from the run's scalars.jsonl.
+
+Usage: python run_accuracy.py [--epochs 30] [--platform tpu|cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import tempfile
+import time
+
+
+def make_digits_npz(root: str, seed: int = 0) -> dict:
+    import numpy as np
+    from sklearn.datasets import load_digits
+    from sklearn.model_selection import train_test_split
+
+    X, y = load_digits(return_X_y=True)
+    X = X.reshape(-1, 8, 8)
+    xtr, xte, ytr, yte = train_test_split(
+        X, y, test_size=0.2, random_state=seed, stratify=y
+    )
+
+    def to_cifar_layout(a):
+        a = np.clip(a * (255.0 / 16.0), 0, 255).astype(np.uint8)
+        a = np.kron(a, np.ones((1, 4, 4), np.uint8))  # 8x8 -> 32x32
+        return np.repeat(a[..., None], 3, axis=-1)  # HW -> HWC3
+
+    np.savez(
+        os.path.join(root, "data.npz"),
+        x_train=to_cifar_layout(xtr),
+        y_train=ytr.astype(np.int64),
+        x_test=to_cifar_layout(xte),
+        y_test=yte.astype(np.int64),
+    )
+    return {"n_train": len(ytr), "n_test": len(yte)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1)
+    # BASELINE config 1 is "kurtosis reg, no KD" — EDE is a separate
+    # reference flag (default False, train.py:125) and its late-phase
+    # sharp estimator destabilized small-dataset runs here
+    ap.add_argument("--ede", action="store_true")
+    ap.add_argument("--arch", default="resnet20")
+    ap.add_argument("--out", default="ACCURACY_r04.json")
+    ap.add_argument("--platform", default="", help="force jax platform")
+    args = ap.parse_args()
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from bdbnn_tpu.configs.config import RunConfig
+    from bdbnn_tpu.train.loop import fit
+
+    with tempfile.TemporaryDirectory() as tmp:
+        counts = make_digits_npz(tmp)
+        log_root = os.path.join(tmp, "log")
+        cfg = RunConfig(
+            data=tmp,
+            dataset="cifar10",
+            arch=args.arch,
+            epochs=args.epochs,
+            batch_size=args.batch,
+            lr=args.lr,
+            w_kurtosis=True,
+            w_kurtosis_target=1.8,
+            w_lambda_kurtosis=1.0,
+            ede=args.ede,
+            seed=0,
+            print_freq=10,
+            log_path=log_root,
+        )
+        t0 = time.time()
+        result = fit(cfg)
+        wall = time.time() - t0
+
+        scalars = []
+        for p in glob.glob(os.path.join(log_root, "**", "scalars.jsonl"),
+                           recursive=True):
+            with open(p) as f:
+                scalars += [json.loads(line) for line in f]
+        curve = {
+            tag: [
+                s["value"]
+                for s in sorted(
+                    (s for s in scalars if s["tag"] == tag),
+                    key=lambda s: s["step"],
+                )
+            ]
+            for tag in ("Val Acc1", "Train Acc1", "Train Loss",
+                        "Train img/s/chip")
+        }
+
+    out = {
+        "what": (
+            "first real-data accuracy point: BASELINE config 1 recipe "
+            f"(binary {args.arch}, kurtosis target 1.8 lambda 1.0, "
+            f"{'EDE, ' if args.ede else ''}SGD momentum 0.9 + cosine, "
+            f"lr {args.lr}, batch {args.batch}) trained end-to-end "
+            "through fit() on real handwritten-digit images (sklearn "
+            "digits, upsampled to CIFAR layout)"
+        ),
+        "why_not_cifar10": (
+            "the CIFAR-10 binaries are not present in this container "
+            "and there is no network egress to download them; sklearn's "
+            "bundled digits is the real image-classification dataset "
+            "available. The code path exercised IS the CIFAR-10 path "
+            "(load via data.npz, same pipeline/augment/train/val loops)."
+        ),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        **counts,
+        "epochs": args.epochs,
+        "ede": args.ede,
+        "lr": args.lr,
+        "arch": args.arch,
+        "batch_size": args.batch,
+        "wall_seconds": round(wall, 1),
+        "best_val_top1": result.get("best_acc1"),
+        "best_epoch": result.get("best_epoch"),
+        "val_top1_curve": [round(v, 3) for v in curve["Val Acc1"]],
+        "train_top1_curve": [round(v, 3) for v in curve["Train Acc1"]],
+        "train_loss_curve": [round(v, 5) for v in curve["Train Loss"]],
+        "train_img_per_sec_per_chip": [
+            round(v, 1) for v in curve["Train img/s/chip"]
+        ],
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({k: v for k, v in out.items()
+                      if k not in ("what", "why_not_cifar10")}))
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,73 @@ class TestPipeline:
         allseen = np.concatenate(got)
         assert len(allseen) == 64
         assert len(np.unique(allseen)) == 64
+
+
+class TestMPImageFolderPipeline:
+    """The pod-grade multiprocess ImageNet feed (VERDICT r3 #4):
+    worker-count-invariant determinism + parity of the shard/batch
+    contract with the thread fallback."""
+
+    @pytest.fixture(scope="class")
+    def jpeg_folder(self, tmp_path_factory):
+        from PIL import Image
+
+        from bdbnn_tpu.data import ImageFolder
+
+        root = tmp_path_factory.mktemp("imgs")
+        rng = np.random.default_rng(0)
+        for cls in ("a", "b"):
+            d = root / "train" / cls
+            d.mkdir(parents=True)
+            for i in range(12):
+                arr = rng.integers(0, 255, size=(64, 80, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i:03d}.jpg")
+        return ImageFolder(str(root / "train"))
+
+    def test_deterministic_across_worker_counts(self, jpeg_folder):
+        from bdbnn_tpu.data import MPImageFolderPipeline
+
+        def batches(workers):
+            pipe = MPImageFolderPipeline(
+                jpeg_folder, 8, train=True, image_size=32, seed=3,
+                num_workers=workers,
+            )
+            return list(pipe.epoch(0))
+
+        b1, b4 = batches(1), batches(4)
+        assert len(b1) == len(b4) == 3  # 24 images / batch 8
+        for (x1, y1), (x4, y4) in zip(b1, b4):
+            np.testing.assert_array_equal(y1, y4)
+            np.testing.assert_array_equal(x1, x4)
+        assert b1[0][0].shape == (8, 32, 32, 3)
+        assert b1[0][0].dtype == np.float32
+
+    def test_eval_ordered_unaugmented_and_remainder(self, jpeg_folder):
+        from bdbnn_tpu.data import MPImageFolderPipeline
+
+        pipe = MPImageFolderPipeline(
+            jpeg_folder, 10, train=False, image_size=32, num_workers=2,
+        )
+        got = list(pipe.epoch(0))
+        # eval keeps the remainder: 24 -> 10 + 10 + 4
+        assert [len(y) for _, y in got] == [10, 10, 4]
+        labels = np.concatenate([y for _, y in got])
+        np.testing.assert_array_equal(
+            labels, [s[1] for s in jpeg_folder.samples]
+        )
+        # deterministic: second epoch identical
+        again = list(pipe.epoch(0))
+        for (x1, _), (x2, _) in zip(got, again):
+            np.testing.assert_array_equal(x1, x2)
+
+    def test_host_sharding_disjoint(self, jpeg_folder):
+        from bdbnn_tpu.data import MPImageFolderPipeline
+
+        def epoch_sample_counts(host_id):
+            pipe = MPImageFolderPipeline(
+                jpeg_folder, 4, train=True, image_size=32, seed=1,
+                host_id=host_id, num_hosts=2, num_workers=2,
+            )
+            return sum(len(y) for _, y in pipe.epoch(0))
+
+        assert epoch_sample_counts(0) + epoch_sample_counts(1) == 24
