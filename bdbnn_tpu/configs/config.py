@@ -41,6 +41,9 @@ class RunConfig:
     # through the net — see BiResNet.twoblock
     twoblock: bool = False
     # schedule
+    # optimizer policy override: "" = reference dataset keying
+    # (CIFAR -> sgd-cosine, ImageNet -> adam-linear, train.py:316-336)
+    opt_policy: str = ""
     epochs: int = 90
     start_epoch: int = 0
     batch_size: int = 256
@@ -112,6 +115,8 @@ class RunConfig:
             raise ValueError("batch_size and epochs must be positive")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown dtype {self.dtype!r}")
+        if self.opt_policy not in ("", "sgd-cosine", "adam-linear"):
+            raise ValueError(f"unknown opt_policy {self.opt_policy!r}")
         if self.pretrained and not self.pretrained_path:
             raise ValueError(
                 "--pretrained needs --pretrained-path (no network egress: "

@@ -1,11 +1,9 @@
-"""TPU fast-path kernels for the binary compute hot spot.
+"""Kernels for the binary compute hot spot.
 
-See :mod:`bdbnn_tpu.nn.kernels.binary_conv` for the int8 MXU
-implicit-GEMM binary convolution (and the analysis of why int8-on-MXU
-beats XNOR-popcount-on-VPU on TPU). The DEFAULT implementation is the
-stock XLA conv; flip it with :func:`set_default_impl` once
-``bench_kernels.py`` / ``bench.py`` record an int8 win on real
-hardware — every path is bit-exact for ±1 operands.
+The binary conv is the stock XLA convolution on ±1 bf16 operands,
+wrapped in a ``custom_vjp`` — the measured winner across rounds; see
+the decision record in :mod:`bdbnn_tpu.nn.kernels.binary_conv` for why
+the int8-MXU and Pallas candidates were deleted with data.
 """
 
 from bdbnn_tpu.nn.kernels.binary_conv import (

@@ -1,37 +1,45 @@
-"""MXU int8 fast path for binary (±1) convolutions.
+"""The binary (±1) convolution hot spot — stock XLA conv, by measurement.
 
-Why int8-on-MXU and not XNOR-popcount-on-VPU
---------------------------------------------
-The classic GPU/CPU trick for 1-bit convs — bitpack to uint32 and
-XNOR+popcount — targets scalar/SIMD ALUs. On TPU the FLOPs live in the
-MXU (128×128 systolic array); the VPU (8×128 vector unit) that would
-execute a popcount path has a fraction of the MXU's throughput, so a
-"true 1-bit" kernel is strictly slower than feeding the MXU. The MXU's
-narrowest native dtype is int8, which runs at 2× the bf16 rate on v5e.
-±1 operands are exactly representable in int8 and a 3×3·C_max=512
-accumulation (≤ 4608) fits int32 exactly, so the int8 path is
-bit-exact vs the float ±1 convolution while doubling the matmul rate
-and quartering operand HBM traffic vs f32. That is the TPU-idiomatic
-answer to the reference's ``HardBinaryConv*`` hot spot (reference
-``train.py:30-32``; SURVEY.md §7.4-3).
+This module is the TPU answer to the reference's ``HardBinaryConv*``
+compute hot spot (reference ``train.py:30-32``; SURVEY.md §7.4-3). It
+routes every binary conv through one ``jax.custom_vjp`` whose forward
+is the XLA convolution on ±1 bf16/f32 operands and whose backward is
+the exact float conv VJP.
 
-Design
-------
-- :func:`binary_conv2d_mxu` — drop-in for the ±alpha binary conv:
-  ``conv(x_pm1, sign_w) * alpha`` with a :func:`jax.custom_vjp` whose
-  backward uses the exact float formulation (int8 is forward-only; the
-  cotangents are float).
-- Forward dispatch: a Pallas implicit-GEMM kernel on TPU (one
-  per-image GEMM ``(H_out·W_out, k·k·C) @ (k·k·C, O)`` assembled in
-  VMEM — im2col never touches HBM), an XLA int8 conv elsewhere, and
-  the plain float conv as the always-correct fallback.
-- The Pallas grid runs one program per image: every binary conv in the
-  BD-BNN model zoo has small spatial maps (≤ 58×58 padded) and
-  C ≤ 512, so a whole image + its im2col matrix fit comfortably in
-  VMEM (≤ ~4 MB of the ~16 MB/core).
+Kernel decision record (round 4 — final)
+----------------------------------------
+Three implementations were built and raced across rounds 1-4:
 
-Enable via :func:`set_default_impl` ("auto" picks the Pallas kernel on
-TPU and the float conv elsewhere) or per-call with ``impl=``.
+- ``dot``      — XLA conv on ±1 operands (bf16 on the MXU). WINNER.
+- ``xla_int8`` — XLA conv on int8 operands, int32 accumulation.
+  Rationale was the MXU's 2x int8 throughput on v5e; measured on the
+  chip (BENCH_r03 ``impl_rates``) it was **~14x SLOWER** than ``dot``
+  (6,815 vs 95,975 img/s under round-3's fencing; both numbers share
+  that methodology, so the ratio — not the absolute — is the
+  evidence). XLA's TPU conv lowering for int8 inputs does not hit the
+  2x MXU fast path; it inserts layout/convert traffic that swamps any
+  MXU gain. DELETED.
+- ``pallas``   — an implicit-GEMM int8 kernel (whole-image im2col in
+  VMEM). It passed interpret-mode correctness tests but **never
+  executed on real hardware**: every on-chip attempt across rounds 2-4
+  raised at Mosaic lowering (BENCH_r03 has no ``pallas`` entry; the
+  bench logs-and-drops the exception). Its unrolled strided int8
+  slicing + concatenate does not fit Mosaic's (32, 128) int8 tiling
+  constraints, and a conforming rewrite has no headroom to win given
+  the int8 conv result above. DELETED after the third round carrying
+  dead code.
+
+Why a "true 1-bit" XNOR-popcount path was never attempted on TPU: the
+classic trick targets scalar/SIMD ALUs; on TPU the FLOPs live in the
+MXU and the VPU that would run popcounts has a fraction of its
+throughput. ±1 operands in bf16 feed the MXU directly — with the
+measured flagship step at 38% MFU (profiles/r04/PROFILE_r04.json) the
+conv path is compute-healthy, and the remaining time is in fusions the
+XLA scheduler already overlaps.
+
+The ``default_impl`` plumbing is kept (now just {"auto", "dot"}) so
+callers/benches keep working and a future kernel can slot back in
+behind the same API.
 """
 
 from __future__ import annotations
@@ -45,7 +53,7 @@ import jax.numpy as jnp
 
 Array = jax.Array
 
-_IMPLS = ("auto", "pallas", "xla_int8", "dot")
+_IMPLS = ("auto", "dot")
 _default_impl = "auto"
 
 
@@ -71,15 +79,6 @@ def default_impl(impl: str):
         set_default_impl(prev)
 
 
-def _resolve(impl: str) -> str:
-    if impl == "auto":
-        # "dot" (stock XLA conv) until the int8 paths have a measured
-        # win on real hardware — bench.py times all three per round and
-        # records the winner; flip this default on that evidence.
-        return "dot"
-    return impl
-
-
 def _fp_conv(x, w, strides, padding):
     return jax.lax.conv_general_dilated(
         x,
@@ -90,133 +89,15 @@ def _fp_conv(x, w, strides, padding):
     )
 
 
-def _xla_int8_conv(xb, wb, strides, padding):
-    """XLA-native int8 conv with int32 accumulation (exact for ±1)."""
-    y = jax.lax.conv_general_dilated(
-        xb.astype(jnp.int8),
-        wb.astype(jnp.int8),
-        window_strides=strides,
-        padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.int32,
-    )
-    return y
-
-
-def _pallas_int8_conv(xb, wb, strides, padding, *, interpret=False):
-    """Implicit-GEMM int8 conv: grid over images, im2col in VMEM.
-
-    ``xb`` (N,H,W,C) ±1, ``wb`` (kh,kw,C,O) ±1, symmetric ``padding``
-    ((ph,ph),(pw,pw)), ``strides`` (1,1) or (2,2). Returns int32
-    (N,Ho,Wo,O).
-    """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    n, h, w_in, c = xb.shape
-    kh, kw, _, o = wb.shape
-    (ph, _), (pw, _) = padding
-    sh, sw = strides
-    ho = (h + 2 * ph - kh) // sh + 1
-    wo = (w_in + 2 * pw - kw) // sw + 1
-
-    xp = jnp.pad(
-        xb.astype(jnp.int8), ((0, 0), (ph, ph), (pw, pw), (0, 0))
-    )
-    w2 = wb.astype(jnp.int8).reshape(kh * kw * c, o)
-    hp, wp = h + 2 * ph, w_in + 2 * pw
-
-    def kernel(x_ref, w_ref, o_ref):
-        img = x_ref[0]  # (hp, wp, c) int8
-        # im2col in VMEM: (ho*wo, kh*kw*c), patch order (dy, dx, c)
-        # matching the HWIO reshape of the kernel above
-        cols = []
-        for dy in range(kh):
-            for dx in range(kw):
-                patch = jax.lax.slice(
-                    img,
-                    (dy, dx, 0),
-                    (dy + sh * (ho - 1) + 1, dx + sw * (wo - 1) + 1, c),
-                    (sh, sw, 1),
-                )
-                cols.append(patch.reshape(ho * wo, c))
-        a = jnp.concatenate(cols, axis=1)
-        acc = jax.lax.dot_general(
-            a,
-            w_ref[:],
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )
-        o_ref[0] = acc.reshape(ho, wo, o)
-
-    return pl.pallas_call(
-        kernel,
-        grid=(n,),
-        in_specs=[
-            pl.BlockSpec(
-                (1, hp, wp, c), lambda i: (i, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (kh * kw * c, o), lambda i: (0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, ho, wo, o), lambda i: (i, 0, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((n, ho, wo, o), jnp.int32),
-        interpret=interpret,
-    )(xp, w2)
-
-
-def _supported_by_pallas(xb, wb, strides, padding) -> bool:
-    if isinstance(padding, str):
-        return False
-    kh, kw, c, o = wb.shape
-    (ph, p2), (pw, p4) = padding
-    if (ph, pw) != (p2, p4):
-        return False
-    if strides not in ((1, 1), (2, 2)):
-        return False
-    # whole padded image + im2col matrix must fit VMEM (~16 MB/core);
-    # stay under ~8 MB to leave room for the accumulator and output
-    n, h, w_in, c2 = xb.shape
-    ho = (h + 2 * ph - kh) // strides[0] + 1
-    wo = (w_in + 2 * pw - kw) // strides[1] + 1
-    im2col_bytes = ho * wo * kh * kw * c
-    acc_bytes = ho * wo * o * 4
-    return im2col_bytes + acc_bytes < 8 * 1024 * 1024
-
-
 @functools.lru_cache(maxsize=None)
-def _make_binary_conv(strides: Tuple[int, int], padding, impl: str,
-                      interpret: bool):
-    """custom_vjp factory, cached per static (strides, padding, impl)."""
+def _make_binary_conv(strides: Tuple[int, int], padding):
+    """custom_vjp factory, cached per static (strides, padding)."""
 
     @jax.custom_vjp
     def conv(xb, wb_sign, alpha):
         return _forward(xb, wb_sign, alpha)
 
     def _forward(xb, wb_sign, alpha):
-        mode = _resolve(impl)
-        if mode == "pallas" and not _supported_by_pallas(
-            xb, wb_sign, strides, padding
-        ):
-            mode = "xla_int8"
-        if mode == "pallas":
-            y = _pallas_int8_conv(
-                xb, wb_sign, strides, padding, interpret=interpret
-            )
-        elif mode == "xla_int8":
-            y = _xla_int8_conv(xb, wb_sign, strides, padding)
-        else:
-            y = _fp_conv(xb, wb_sign.astype(xb.dtype), strides, padding)
-        return (y.astype(alpha.dtype) * alpha).astype(xb.dtype)
-
-    def _ref(xb, wb_sign, alpha):
-        # exact float formulation — the backward's source of truth
         y = _fp_conv(xb, wb_sign.astype(xb.dtype), strides, padding)
         return (y.astype(alpha.dtype) * alpha).astype(xb.dtype)
 
@@ -225,7 +106,7 @@ def _make_binary_conv(strides: Tuple[int, int], padding, impl: str,
 
     def bwd(res, g):
         xb, wb_sign, alpha = res
-        _, vjp = jax.vjp(_ref, xb, wb_sign, alpha)
+        _, vjp = jax.vjp(_forward, xb, wb_sign, alpha)
         return vjp(g)
 
     conv.defvjp(fwd, bwd)
@@ -246,13 +127,14 @@ def binary_conv2d_mxu(
 
     ``xb`` ±1 activations (N,H,W,C); ``wb_sign`` ±1 kernel (kh,kw,C,O);
     ``alpha`` per-output-channel scale broadcastable to (..., O).
-    ``impl="default"`` follows :func:`get_default_impl` (the stock XLA
-    conv unless a measured int8 win flipped it); all paths are bit-exact
-    for ±1 operands and the backward is always the float conv's VJP.
+    The single implementation is the stock XLA conv on ±1 operands —
+    the measured winner; see the module docstring's decision record.
     ``padding`` accepts "auto" (torch-style symmetric k//2), explicit
-    ((ph, ph), (pw, pw)) pairs, or an XLA string ("SAME"/"VALID" — the
-    Pallas path then falls back to XLA).
+    ((ph, ph), (pw, pw)) pairs, or an XLA string ("SAME"/"VALID").
+    ``impl``/``interpret`` are accepted for API stability; any impl
+    other than "auto"/"dot"/"default" raises.
     """
+    del interpret  # no pallas path anymore; kept for API stability
     if padding == "auto":
         kh, kw = wb_sign.shape[0], wb_sign.shape[1]
         padding = ((kh // 2, kh // 2), (kw // 2, kw // 2))
@@ -260,6 +142,11 @@ def binary_conv2d_mxu(
         padding = tuple((int(a), int(b)) for a, b in padding)
     if impl == "default":
         impl = get_default_impl()
+    if impl not in _IMPLS:
+        raise ValueError(
+            f"impl must be one of {_IMPLS}, got {impl!r} — the int8/"
+            "pallas paths were deleted with measurement (module docstring)"
+        )
     alpha = jnp.reshape(jnp.asarray(alpha, xb.dtype), (1, 1, 1, -1))
-    fn = _make_binary_conv(tuple(strides), padding, impl, interpret)
+    fn = _make_binary_conv(tuple(strides), padding)
     return fn(xb, wb_sign, alpha)

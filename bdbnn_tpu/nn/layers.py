@@ -107,11 +107,10 @@ class _BinaryConvBase(nn.Module):
 
     def binary_conv(self, xb: Array, in_features: int) -> Array:
         """±alpha binary conv, routed through
-        :func:`bdbnn_tpu.nn.kernels.binary_conv2d_mxu`. The default
-        implementation is the stock XLA conv; the int8 MXU fast paths
-        are opt-in (``kernels.set_default_impl``) until bench.py records
-        a measured win on real hardware — all paths are bit-exact for ±1
-        operands, see nn/kernels/binary_conv.py."""
+        :func:`bdbnn_tpu.nn.kernels.binary_conv2d_mxu` — the stock XLA
+        conv on ±1 operands (the measured winner; the int8/Pallas
+        candidates were deleted with data, see the decision record in
+        nn/kernels/binary_conv.py)."""
         w = self.latent_weight(in_features).astype(xb.dtype)
         signed = ste_sign(w)
         reduce_axes = tuple(range(w.ndim - 1))

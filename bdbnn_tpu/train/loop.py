@@ -315,6 +315,19 @@ def build_teacher(cfg: RunConfig, image_size: int):
 
 def fit(cfg: RunConfig) -> Dict[str, float]:
     """End-to-end training (↔ ``main_worker`` + epoch loop)."""
+    pipes: list = []
+    try:
+        return _fit(cfg, pipes)
+    finally:
+        # release input-worker pools (MPImageFolderPipeline spawns
+        # processes that otherwise live until GC)
+        for p in pipes:
+            close = getattr(p, "close", None)
+            if callable(close):
+                close()
+
+
+def _fit(cfg: RunConfig, _pipes: list) -> Dict[str, float]:
     cfg = cfg.validate()
     if cfg.distributed_init:
         jax.distributed.initialize()
@@ -328,6 +341,7 @@ def fit(cfg: RunConfig) -> Dict[str, float]:
         np.random.seed(cfg.seed)
 
     train_pipe, val_pipe, image_size = build_datasets(cfg)
+    _pipes.extend((train_pipe, val_pipe))
     steps_per_epoch = max(train_pipe.steps_per_epoch(), 1)
 
     mesh = make_mesh(model_parallel=cfg.model_parallel)
@@ -371,6 +385,7 @@ def fit(cfg: RunConfig) -> Dict[str, float]:
         steps_per_epoch=steps_per_epoch,
         momentum=cfg.momentum,
         weight_decay=cfg.weight_decay,
+        policy=cfg.opt_policy,
     )
     state = create_sharded_state(mesh, variables, tx, TrainState)
 

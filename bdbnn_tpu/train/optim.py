@@ -76,9 +76,24 @@ def make_optimizer(
     steps_per_epoch: int,
     momentum: float = 0.9,
     weight_decay: float = 1e-4,
+    policy: str = "",
 ) -> optax.GradientTransformation:
-    """The full reference policy keyed on dataset (``train.py:316-336``)."""
-    if dataset == "imagenet":
+    """The full reference policy keyed on dataset (``train.py:316-336``).
+
+    ``policy`` overrides the dataset keying: "sgd-cosine" (the
+    reference's CIFAR policy) or "adam-linear" (its ImageNet policy,
+    masked weight decay). Useful because deep binary nets on small
+    datasets learn far faster under the adaptive policy — both
+    policies remain exactly the reference's own.
+    """
+    if policy and policy not in ("sgd-cosine", "adam-linear"):
+        raise ValueError(f"unknown opt policy {policy!r}")
+    adam = (
+        policy == "adam-linear"
+        if policy
+        else dataset == "imagenet"
+    )
+    if adam:
         schedule = linear_epoch_schedule(lr, epochs, steps_per_epoch)
         return optax.chain(
             optax.masked(

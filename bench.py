@@ -319,20 +319,10 @@ def worker_main(args) -> None:
         extras["bf16_speedup_vs_f32"] = round(rates["dot"] / f32, 3)
         emit()
 
-    for impl in ("xla_int8", "pallas") if args.try_int8 else ():
-        try:
-            with default_impl(impl):
-                ci, si, bxyi, tki, gi, fi = _compile_step(
-                    "bfloat16", args.batch
-                )
-                r, _ = _measure_compiled(
-                    ci, si, bxyi, tki, gi, args.batch, args.iters
-                )
-                rates[impl] = r / n_chips
-                flops_by_impl[impl] = fi
-            emit()
-        except Exception as e:
-            print(f"[bench] impl {impl} failed: {e}", file=sys.stderr)
+    # the int8 / pallas impl stages were retired in round 4: xla_int8
+    # measured ~14x slower on-chip and pallas never survived Mosaic
+    # lowering — see the decision record in nn/kernels/binary_conv.py
+    # and KERNELS_r04.json. "dot" is the only implementation.
 
 
 def main() -> None:
@@ -350,8 +340,10 @@ def main() -> None:
     )
     ap.add_argument("--no-compare", dest="compare", action="store_false",
                     help="skip the f32 comparison run")
+    # accepted-and-ignored for compatibility with older drivers: the
+    # int8/pallas stages were retired with measurement in round 4
     ap.add_argument("--no-int8", dest="try_int8", action="store_false",
-                    help="skip the int8 conv implementations")
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.worker:
@@ -368,8 +360,6 @@ def main() -> None:
             cmd += ["--profile-dir", args.profile_dir]
         if not args.compare:
             cmd.append("--no-compare")
-        if not args.try_int8:
-            cmd.append("--no-int8")
         try:
             proc = subprocess.run(
                 cmd, capture_output=True, text=True, timeout=args.timeout,

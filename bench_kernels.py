@@ -1,24 +1,26 @@
-"""Microbenchmark: binary-conv implementations on the attached chip.
+"""Microbenchmark: the binary-conv hot spot, per layer shape.
 
-Compares, per binary-conv shape of ImageNet binary ResNet-18:
-  - dot       — XLA conv on ±1 float operands (f32 and bf16)
-  - xla_int8  — XLA conv on int8 operands, int32 accumulation
-  - pallas    — the implicit-GEMM int8 MXU kernel
+Times the surviving implementation — the stock XLA conv on ±1 operands
+— in f32 vs bf16 for every binary-conv shape of ImageNet binary
+ResNet-18 (the reference's ``HardBinaryConv*`` hot spot,
+``train.py:30-32``).
 
-Run on real TPU:   python bench_kernels.py
-Run on CPU (correctness only, interpret mode): JAX_PLATFORMS=cpu ...
+Historical context (the kernel race this bench used to run): an XLA
+int8 conv and a Pallas implicit-GEMM int8 kernel were candidates
+through rounds 1-4. The int8 path measured ~14x slower than the stock
+conv on the chip (BENCH_r03 ``impl_rates``) and the Pallas kernel
+never survived Mosaic lowering on hardware; both were deleted — full
+decision record in ``bdbnn_tpu/nn/kernels/binary_conv.py`` and
+``KERNELS_r04.json``.
 
-Prints one JSON line per (shape, impl) with images/sec, then a summary
-line naming the winner — the recorded evidence for which path the
-binary convs default to (VERDICT round 1 asked for the kernel to win
-or be killed with data; see nn/kernels/binary_conv.py for the
-int8-vs-XNOR analysis).
+Run on real TPU:  python bench_kernels.py [--out KERNELS.json]
+Run on CPU:       JAX_PLATFORMS=cpu python bench_kernels.py (relative
+numbers only)
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import time
 
 import numpy as np
@@ -50,17 +52,8 @@ def main(batch: int = 64, iters: int = 20, out_path: str = "") -> None:
     import jax.numpy as jnp
 
     from bdbnn_tpu.nn.kernels import binary_conv2d_mxu
-    from bdbnn_tpu.nn.layers import conv2d
 
     platform = jax.devices()[0].platform
-    interpret = platform != "tpu"
-    if interpret:
-        print(
-            f"[bench_kernels] platform={platform}: pallas runs in "
-            "interpret mode — timings are NOT meaningful, correctness only",
-            file=sys.stderr,
-        )
-        iters = 1
 
     rng = np.random.default_rng(0)
     results = []
@@ -74,20 +67,14 @@ def main(batch: int = 64, iters: int = 20, out_path: str = "") -> None:
         alpha = jnp.asarray(rng.uniform(0.1, 1.0, size=(o,)), jnp.float32)
 
         impls = {
-            "dot_f32": lambda xb=xb, wb=wb: conv2d(
-                xb, wb * alpha.reshape(1, 1, 1, -1), strides=(s, s)
+            "dot_f32": lambda xb=xb, wb=wb, alpha=alpha: binary_conv2d_mxu(
+                xb, wb, alpha, strides=(s, s)
             ),
-            "dot_bf16": lambda xb=xb, wb=wb: conv2d(
+            "dot_bf16": lambda xb=xb, wb=wb, alpha=alpha: binary_conv2d_mxu(
                 xb.astype(jnp.bfloat16),
-                (wb * alpha.reshape(1, 1, 1, -1)).astype(jnp.bfloat16),
+                wb.astype(jnp.bfloat16),
+                alpha,
                 strides=(s, s),
-            ),
-            "xla_int8": lambda xb=xb, wb=wb: binary_conv2d_mxu(
-                xb, wb, alpha, strides=(s, s), impl="xla_int8"
-            ),
-            "pallas": lambda xb=xb, wb=wb: binary_conv2d_mxu(
-                xb, wb, alpha, strides=(s, s), impl="pallas",
-                interpret=interpret,
             ),
         }
         ref = None
@@ -153,7 +140,6 @@ def main(batch: int = 64, iters: int = 20, out_path: str = "") -> None:
         "totals_ms": {k: round(v, 3) for k, v in totals.items()},
         "winner": min(totals, key=totals.get) if totals else None,
         "platform": platform,
-        "interpret": interpret,
         "batch": batch,
         "fencing": "scalar D2H fetch per window, median of 5 windows",
         "results": results,

@@ -56,8 +56,10 @@ def measure(pipe, n_batches: int) -> float:
     t0 = time.perf_counter()
     n = 0
     for _ in range(n_batches):
-        x, y = next(it)
-        n += len(y)
+        batch = next(it, None)
+        if batch is None:  # dataset too small for the requested window
+            break
+        n += len(batch[1])
     dt = time.perf_counter() - t0
     it.close()  # release the generator; pool cleanup is the pipeline's
     if hasattr(pipe, "close"):

@@ -442,3 +442,45 @@ class TestEvalStep:
         )
         assert int(m_masked["top1"]) == int(m_ref["top1"])
         assert int(m_masked["top5"]) == int(m_ref["top5"])
+
+
+class TestOptPolicyOverride:
+    """opt_policy overrides the reference's dataset->optimizer keying
+    with the OTHER reference policy (train.py:316-336)."""
+
+    def test_override_matches_other_datasets_policy(self):
+        rng = np.random.default_rng(0)
+        model = _tiny_model()
+        x, _ = _tiny_batch(rng)
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        p = variables["params"]
+        grads = jax.tree_util.tree_map(jnp.ones_like, p)
+
+        def first_update(tx):
+            st = tx.init(p)
+            up, _ = tx.update(grads, st, p)
+            return up
+
+        adam_by_ds = make_optimizer(
+            p, dataset="imagenet", lr=0.1, epochs=5, steps_per_epoch=3
+        )
+        adam_by_policy = make_optimizer(
+            p, dataset="cifar10", lr=0.1, epochs=5, steps_per_epoch=3,
+            policy="adam-linear",
+        )
+        a, b = first_update(adam_by_ds), first_update(adam_by_policy)
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb))
+
+    def test_rejects_unknown_policy(self):
+        rng = np.random.default_rng(0)
+        model = _tiny_model()
+        x, _ = _tiny_batch(rng)
+        p = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+        with pytest.raises(ValueError):
+            make_optimizer(
+                p, dataset="cifar10", lr=0.1, epochs=5, steps_per_epoch=3,
+                policy="rmsprop",
+            )
