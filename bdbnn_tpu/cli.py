@@ -93,6 +93,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="compute dtype (bf16 doubles MXU throughput; params stay f32)",
     )
     p.add_argument(
+        "--target-acc", type=float, default=0.0,
+        help="record wall-clock seconds until val top-1 first reaches "
+        "this PERCENTAGE in [0, 100), e.g. 63.0 (north-star metric; "
+        "0 disables; from-scratch runs only)",
+    )
+    p.add_argument(
+        "--device-normalize", action="store_true",
+        help="ship raw uint8 batches to device (4x less H2D traffic); "
+        "the jitted step normalizes on device",
+    )
+    p.add_argument(
+        "--opt-policy", default="", choices=["", "sgd-cosine", "adam-linear"],
+        help="override the reference's dataset->optimizer keying with "
+        "the other reference policy (train.py:316-336)",
+    )
+    p.add_argument(
         "--profile-dir", default="", type=str,
         help="write a jax.profiler trace of a few epoch-0 steps here",
     )
@@ -172,6 +188,9 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         synthetic=args.synthetic,
         pretrained_path=args.pretrained_path,
         dtype=args.dtype,
+        device_normalize=args.device_normalize,
+        opt_policy=args.opt_policy,
+        target_acc=args.target_acc,
         profile_dir=args.profile_dir,
     )
 

@@ -92,6 +92,15 @@ class RunConfig:
     distributed_init: bool = False  # call jax.distributed.initialize()
     # compute
     dtype: str = "float32"  # float32 | bfloat16 activations
+    # TPU-first input path: pipelines ship RAW uint8 batches (4x less
+    # host->device traffic) and the jitted step normalizes on device,
+    # where it fuses into the first conv's prologue
+    device_normalize: bool = False
+    # north-star metric (BASELINE.json: "wall-clock to 63%"): when > 0,
+    # fit() records the wall-clock seconds at which val top-1 first
+    # reaches this PERCENTAGE in [0, 100) — e.g. 63.0, not 0.63
+    # (run continues; see "time_to_target_s"); from-scratch runs only
+    target_acc: float = 0.0
     # observability (SURVEY.md §5.1): write a jax.profiler trace for
     # steps [profile_start, profile_start+profile_steps) of epoch 0
     profile_dir: str = ""
@@ -117,6 +126,16 @@ class RunConfig:
             raise ValueError(f"unknown dtype {self.dtype!r}")
         if self.opt_policy not in ("", "sgd-cosine", "adam-linear"):
             raise ValueError(f"unknown opt_policy {self.opt_policy!r}")
+        if not 0.0 <= self.target_acc < 100.0:
+            raise ValueError(
+                f"target_acc is a top-1 PERCENTAGE in [0, 100), got "
+                f"{self.target_acc!r} (63% is 63.0, not 0.63)"
+            )
+        if self.device_normalize and self.synthetic:
+            raise ValueError(
+                "--device-normalize needs uint8 pipelines; the synthetic "
+                "smoke pipeline emits pre-normalized floats"
+            )
         if self.pretrained and not self.pretrained_path:
             raise ValueError(
                 "--pretrained needs --pretrained-path (no network egress: "

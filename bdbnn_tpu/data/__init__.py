@@ -16,6 +16,7 @@ from bdbnn_tpu.data.pipeline import (
     Pipeline,
     cifar_eval_transform,
     cifar_train_augment,
+    cifar_train_augment_u8,
     host_shard_indices,
     normalize,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "Pipeline",
     "cifar_eval_transform",
     "cifar_train_augment",
+    "cifar_train_augment_u8",
     "host_shard_indices",
     "normalize",
 ]

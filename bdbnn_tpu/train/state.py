@@ -78,6 +78,12 @@ class StepConfig:
     kd_pairs: Tuple[Tuple[Tuple[str, ...], Tuple[str, ...]], ...] = ()
     # EDE
     ede: bool = False
+    # device-side input normalization (TPU-first input path): when set
+    # to per-channel ((mean,...), (std,...)) in 0-1 scale, the step
+    # receives RAW uint8 NHWC batches and normalizes on device — the
+    # host->device transfer carries 1 byte/px instead of 4 and the
+    # normalize fuses into the first conv's prologue under XLA
+    input_norm: Optional[Tuple[Tuple[float, ...], Tuple[float, ...]]] = None
 
     def resolved(self) -> "StepConfig":
         """Apply the react-mode overrides the reference applies inside

@@ -62,6 +62,20 @@ def topk_correct(
     return out
 
 
+def _prep_images(images: Array, input_norm) -> Array:
+    """Device-side normalization of raw uint8 batches (StepConfig.
+    input_norm): identical math to the host pipeline's ``normalize`` —
+    ``(x/255 - mean)/std`` in float32 — executed on device where it
+    fuses into the first conv's prologue."""
+    if input_norm is None:
+        return images
+    mean, std = input_norm
+    x = images.astype(jnp.float32) / 255.0
+    return (x - jnp.asarray(mean, jnp.float32)) / jnp.asarray(
+        std, jnp.float32
+    )
+
+
 def _regularization_terms(params, cfg: StepConfig, kurt_gate: Array):
     """λ·kurt (+ optional L2 / |W|→±1) over the hooked latent weights."""
     terms = {}
@@ -96,6 +110,7 @@ def make_train_step(
 
     def train_step(state: TrainState, batch: Batch, tk: Array, kurt_gate: Array):
         images, labels = batch
+        images = _prep_images(images, cfg.input_norm)
 
         def loss_fn(params):
             kwargs = {"tk": tk} if cfg.ede else {}
@@ -156,6 +171,7 @@ def make_ts_train_step(
         kurt_gate: Array,
     ):
         images, labels = batch
+        images = _prep_images(images, cfg.input_norm)
 
         def loss_fn(params):
             kwargs = {"tk": tk} if cfg.ede else {}
@@ -217,7 +233,7 @@ def make_ts_train_step(
     return ts_train_step
 
 
-def make_eval_step(model) -> Callable:
+def make_eval_step(model, input_norm=None) -> Callable:
     """Validation step (↔ ``validate()``, ``train.py:677-714``).
 
     Takes ``(images, labels, valid)``: eval batches are padded to a
@@ -226,10 +242,12 @@ def make_eval_step(model) -> Callable:
     every reduction. Returns SUMS — with sharded inputs GSPMD reduces
     them globally, so each host sees the global counts (the reference's
     ``validate()`` had no cross-rank reduction; host-local accuracy
-    drove best-model selection)."""
+    drove best-model selection). ``input_norm`` as in StepConfig:
+    uint8 batches normalized on device."""
 
     def eval_step(state: TrainState, batch):
         images, labels, valid = batch
+        images = _prep_images(images, input_norm)
         logits = model.apply(state.variables, images, train=False)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]

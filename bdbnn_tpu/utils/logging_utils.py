@@ -72,6 +72,9 @@ class ScalarWriter:
             self._tb.add_scalar(tag, float(value), step)
 
     def close(self) -> None:
-        self._jsonl.close()
+        """Idempotent: fit() closes on every exit path."""
+        if not self._jsonl.closed:
+            self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
+            self._tb = None

@@ -72,3 +72,35 @@ class TestFitSmoke:
         cfg = _cfg(tmp_path, synthetic=False, data=str(tmp_path / "nope"))
         with pytest.raises(FileNotFoundError, match="not found"):
             fit(cfg)
+
+
+class TestDeviceNormalizeFit:
+    def test_fit_with_device_normalize_and_target_acc(self, tmp_path):
+        """End-to-end: uint8 pipelines + on-device normalize + the
+        north-star time-to-target clock, through the real CIFAR npz
+        data path."""
+        rng = np.random.default_rng(0)
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        np.savez(
+            data_dir / "data.npz",
+            x_train=rng.integers(0, 256, (256, 32, 32, 3), dtype=np.uint8),
+            y_train=rng.integers(0, 10, (256,)).astype(np.int64),
+            x_test=rng.integers(0, 256, (64, 32, 32, 3), dtype=np.uint8),
+            y_test=rng.integers(0, 10, (64,)).astype(np.int64),
+        )
+        cfg = _cfg(
+            tmp_path,
+            synthetic=False,
+            data=str(data_dir),
+            device_normalize=True,
+            target_acc=0.1,  # any nonzero accuracy crosses it
+            epochs=2,
+        )
+        res = fit(cfg)
+        assert np.isfinite(res["best_acc1"])
+        assert "time_to_target_s" in res and res["time_to_target_s"] > 0
+
+    def test_synthetic_rejects_device_normalize(self, tmp_path):
+        with pytest.raises(ValueError):
+            fit(_cfg(tmp_path, device_normalize=True))
