@@ -30,10 +30,11 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-# The `fast` tier (`pytest -m fast`, <60s): pure-numerics oracle tests —
-# binarization custom_vjps, kurtosis/KD losses, optimizer + EDE-schedule
-# torch parity. The full suite stays the default.
-_FAST_MODULES = {"test_binarize", "test_kurtosis", "test_kd"}
+# The `fast` tier (`pytest -m fast`, <60s): pure-numerics oracle tests
+# (binarization custom_vjps, kurtosis/KD losses, optimizer + EDE-schedule
+# torch parity) plus the no-jax CLI flag-surface tests. The full suite
+# stays the default.
+_FAST_MODULES = {"test_binarize", "test_kurtosis", "test_kd", "test_cli"}
 _FAST_CLASSES = {"TestOptimizerParity", "TestEDESchedule"}
 
 

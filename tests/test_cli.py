@@ -1,0 +1,124 @@
+"""CLI surface tests: the drop-in contract with the reference.
+
+The reference is launched as ``python train.py DATA [flags]`` with the
+flag surface of SURVEY.md Appendix A (reference ``train.py:64-171``).
+MIGRATION.md promises every reference flag parses here with the same
+spelling and default; these tests pin that promise.
+"""
+
+import pytest
+
+from bdbnn_tpu.cli import args_to_config, build_parser
+
+
+def parse(argv):
+    return args_to_config(build_parser().parse_args(argv))
+
+
+class TestReferenceFlagSurface:
+    def test_defaults_match_reference(self):
+        """Reference training-recipe defaults (train.py:74-170)."""
+        cfg = parse(["/data"])
+        assert cfg.epochs == 90
+        assert cfg.batch_size == 256
+        assert cfg.lr == 0.1
+        assert cfg.momentum == 0.9
+        assert cfg.weight_decay == 1e-4
+        assert cfg.w_kurtosis_target == 1.8
+        assert cfg.w_lambda_kurtosis == 1.0
+        assert cfg.alpha == 0.9
+        assert cfg.temperature == 4
+        assert cfg.beta == 200
+        assert cfg.kurtosis_mode == "avg"
+        assert cfg.weight_name == ("all",)
+
+    def test_every_reference_flag_parses(self):
+        """One pass over the full Appendix-A surface."""
+        cfg = parse(
+            [
+                "/data", "--dataset", "cifar10", "-a", "resnet20",
+                "-j", "8", "--epochs", "120", "--start-epoch", "3",
+                "-b", "128", "-lr", "0.01", "--momentum", "0.8",
+                "-wd", "5e-4", "-p", "50", "--resume", "ck.pth.tar",
+                "--pretrained", "--seed", "7", "--log_path", "mylog",
+                "--custom_resnet", "--reset_resume", "--ede",
+                "--w-kurtosis", "--w-kurtosis-target", "2.0",
+                "--w-lambda-kurtosis", "0.5", "--weight-name", "all",
+                "--remove-weight-name", "layer1_0.conv1",
+                "--kurtosis-mode", "sum", "--diffkurt", "--kurtepoch", "5",
+                "--twoblock", "--imagenet_setting_step_2_ts",
+                "-a_teacher", "resnet34_float", "--custom_resnet_teacher",
+                "--resume_teacher", "t.pth.tar", "--kd", "--react",
+                "--alpha", "0.5", "--temperature", "2", "--beta", "100",
+            ]
+        )
+        assert cfg.arch == "resnet20"
+        assert cfg.epochs == 120 and cfg.start_epoch == 3
+        assert cfg.kurtepoch == 5 and cfg.diffkurt and cfg.twoblock
+        assert cfg.remove_weight_name == ("layer1_0.conv1",)
+        assert cfg.react and cfg.imagenet_setting_step_2_ts
+
+    def test_legacy_nccl_flags_parse_and_note(self, capsys):
+        """GPU/NCCL-era flags parse, print a note, change nothing."""
+        cfg = parse(
+            [
+                "/data", "--multiprocessing-distributed", "--world-size",
+                "4", "--rank", "1", "--dist-url", "tcp://h:1234",
+                "--dist-backend", "nccl", "--gpu", "0",
+            ]
+        )
+        err = capsys.readouterr().err
+        assert "ignored" in err and "world-size" in err
+        # nothing distributed was configured from them
+        assert cfg.model_parallel == 1 and not cfg.distributed_init
+
+    @pytest.mark.parametrize(
+        "argv",
+        [
+            # the MIGRATION.md acceptance-config command lines
+            ["/d", "--dataset", "cifar10", "-a", "resnet20",
+             "--w-kurtosis", "--w-kurtosis-target", "1.8",
+             "--w-lambda-kurtosis", "1.0", "--ede"],
+            ["/d", "--dataset", "cifar10", "-a", "resnet18",
+             "--imagenet_setting_step_2_ts", "--arch_teacher",
+             "resnet18_float", "--resume_teacher", "t.pth.tar",
+             "--alpha", "0.9", "--temperature", "4", "--beta", "200",
+             "--w-kurtosis"],
+            ["/d", "--dataset", "imagenet", "-a", "resnet18",
+             "--w-kurtosis", "--w-kurtosis-target", "1.8",
+             "--w-lambda-kurtosis", "1.0", "--dtype", "bfloat16"],
+            ["/d", "--dataset", "imagenet", "-a", "resnet34",
+             "--imagenet_setting_step_2_ts", "--react",
+             "--arch_teacher", "resnet34_float", "--resume_teacher",
+             "t.pth.tar", "--w-kurtosis", "--dtype", "bfloat16"],
+            ["/d", "--dataset", "imagenet", "-a", "resnet18",
+             "--distributed-init", "--w-kurtosis", "--dtype",
+             "bfloat16"],
+        ],
+    )
+    def test_migration_doc_commands_parse(self, argv):
+        cfg = parse(argv)
+        assert cfg.data == "/d"
+        # TS is gated on --imagenet_setting_step_2_ts, exactly as in
+        # the reference (train.py:417; its --kd flag is dead there too)
+        assert cfg.teacher_student == ("--imagenet_setting_step_2_ts" in argv)
+
+
+class TestTpuNativeFlags:
+    def test_parallelism_and_dtype(self):
+        cfg = parse(
+            [
+                "/data", "--model-parallel", "2", "--distributed-init",
+                "--dtype", "bfloat16", "--device-normalize",
+                "--target-acc", "63.0", "--opt-policy", "adam-linear",
+                "--profile-dir", "/tmp/prof",
+            ]
+        )
+        assert cfg.model_parallel == 2 and cfg.distributed_init
+        assert cfg.dtype == "bfloat16" and cfg.device_normalize
+        assert cfg.target_acc == 63.0
+        assert cfg.opt_policy == "adam-linear"
+
+    def test_bad_dataset_rejected(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["/d", "--dataset", "mnist"])
