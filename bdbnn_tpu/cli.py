@@ -24,7 +24,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="BD-BNN TPU training")
     p.add_argument("data", nargs="?", default="", help="dataset directory")
     p.add_argument("-a", "--arch", default="resnet18")
-    p.add_argument("-j", "--workers", type=int, default=4)
+    p.add_argument(
+        "-j", "--workers", type=int, default=4,
+        help="decode workers for the mp/threads input backends "
+        "(tfdata autotunes its C++ pool to the host)",
+    )
     p.add_argument("--epochs", type=int, default=90)
     p.add_argument("--start-epoch", type=int, default=0)
     p.add_argument("-b", "--batch-size", type=int, default=256)
@@ -102,6 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--device-normalize", action="store_true",
         help="ship raw uint8 batches to device (4x less H2D traffic); "
         "the jitted step normalizes on device",
+    )
+    p.add_argument(
+        "--input-backend", default="auto",
+        choices=["auto", "tfdata", "mp", "threads"],
+        help="ImageNet input engine: tfdata (tf.data C++ threadpool, "
+        "pod-grade), mp (worker processes like the reference's "
+        "DataLoader), threads (in-process fallback); auto picks tfdata "
+        "when tensorflow is importable",
     )
     p.add_argument(
         "--opt-policy", default="", choices=["", "sgd-cosine", "adam-linear"],
@@ -190,6 +202,7 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         dtype=args.dtype,
         device_normalize=args.device_normalize,
         opt_policy=args.opt_policy,
+        input_backend=args.input_backend,
         target_acc=args.target_acc,
         profile_dir=args.profile_dir,
     )

@@ -28,6 +28,12 @@ class RunConfig:
     data: str = ""  # dataset dir (positional in the reference)
     dataset: str = "cifar10"  # cifar10 | cifar100 | imagenet
     workers: int = 4
+    # ImageNet input engine: tfdata (tf.data C++ threadpool — the
+    # BASELINE.json-named pod-grade path), mp (worker processes, ↔ the
+    # reference's 16 DataLoader workers), threads (in-process fallback).
+    # auto = tfdata when tensorflow is importable, else mp/threads by
+    # --workers.
+    input_backend: str = "auto"  # auto | tfdata | mp | threads
     synthetic: bool = False  # train on random tensors (smoke/bench only)
     synthetic_train_size: int = 2048
     synthetic_val_size: int = 512
@@ -126,6 +132,8 @@ class RunConfig:
             raise ValueError(f"unknown dtype {self.dtype!r}")
         if self.opt_policy not in ("", "sgd-cosine", "adam-linear"):
             raise ValueError(f"unknown opt_policy {self.opt_policy!r}")
+        if self.input_backend not in ("auto", "tfdata", "mp", "threads"):
+            raise ValueError(f"unknown input_backend {self.input_backend!r}")
         if not 0.0 <= self.target_acc < 100.0:
             raise ValueError(
                 f"target_acc is a top-1 PERCENTAGE in [0, 100), got "

@@ -35,6 +35,8 @@ from bdbnn_tpu.data import (
     ImageFolder,
     ImageFolderPipeline,
     MPImageFolderPipeline,
+    TFDataImageFolderPipeline,
+    tfdata_available,
     Pipeline,
     load_cifar10,
     load_cifar100,
@@ -149,10 +151,43 @@ def build_datasets(cfg: RunConfig):
         return mk(train_ds, True), mk(val_ds, False), image_size
 
     try:
-        # worker PROCESSES (↔ the reference's 16 DataLoader workers,
-        # loader.py:83); --workers 0 falls back to the in-process
-        # thread pipeline (tests, debugging)
-        if cfg.workers > 0:
+        # Input engine (cfg.input_backend; SURVEY §2.1 #19):
+        #   tfdata  — tf.data C++ threadpool, the BASELINE.json pod path
+        #   mp      — worker processes (↔ reference's 16 DataLoader
+        #             workers, loader.py:83)
+        #   threads — in-process fallback (tests, debugging)
+        # auto = tfdata when tensorflow is present, else mp/threads by
+        # --workers.
+        backend = cfg.input_backend
+        if backend == "auto":
+            backend = (
+                "tfdata"
+                if tfdata_available()
+                else ("mp" if cfg.workers > 0 else "threads")
+            )
+        elif backend == "tfdata" and not tfdata_available():
+            # fail BEFORE model build/compile, not minutes later at the
+            # first epoch's _import_tf()
+            raise RuntimeError(
+                "--input-backend tfdata requested but tensorflow is not "
+                "importable here; install it or use --input-backend mp"
+            )
+        if backend == "mp" and cfg.workers <= 0:
+            backend = "threads"
+        if backend == "tfdata":
+            # tf.data autotunes its C++ pool to the host (that is the
+            # point of this backend); -j sizes the mp/threads backends.
+            # A private fixed-size pool remains reachable via the class.
+            mk_folder = lambda split, train: TFDataImageFolderPipeline(
+                ImageFolder(os.path.join(cfg.data, split)),
+                per_host_batch,
+                train=train,
+                seed=cfg.seed or 0,
+                host_id=host_id,
+                num_hosts=num_hosts,
+                device_normalize=cfg.device_normalize,
+            )
+        elif backend == "mp":
             mk_folder = lambda split, train: MPImageFolderPipeline(
                 ImageFolder(os.path.join(cfg.data, split)),
                 per_host_batch,

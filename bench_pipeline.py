@@ -5,10 +5,12 @@ VERDICT r3 task 4: the training chip sustains ~7.5k img/s on the
 flagship step (profiles/r04/PROFILE_r04.json), so the input pipeline —
 not the chip — is the binding constraint unless it scales past that.
 This measures the thread fallback vs the multiprocess pipeline
-(MPImageFolderPipeline) on a generated JPEG ImageFolder and writes
-PIPELINE_r04.json with per-worker scaling + the host-core count needed
-to saturate the measured device rate. Reference anchor: 16 DataLoader
-worker processes, ``loader.py:83``.
+(MPImageFolderPipeline) vs the tf.data engine
+(TFDataImageFolderPipeline — the BASELINE.json-named pod path) on a
+generated JPEG ImageFolder and writes PIPELINE_r04.json with per-worker
+scaling + the host-core count needed to saturate the measured device
+rate. Reference anchor: 16 DataLoader worker processes,
+``loader.py:83``.
 
 Usage: python bench_pipeline.py [--out PIPELINE_r04.json]
 """
@@ -113,6 +115,26 @@ def main():
             out["processes_img_per_sec"][str(workers)] = round(rate, 1)
             print(f"processes={workers}: {rate:8.1f} img/s", flush=True)
 
+        try:
+            from bdbnn_tpu.data import (
+                TFDataImageFolderPipeline,
+                tfdata_available,
+            )
+
+            if tfdata_available():
+                out["tfdata_img_per_sec"] = {}
+                for threads in (0, 4):  # 0 = autotuned shared pool
+                    pipe = TFDataImageFolderPipeline(
+                        folder, args.batch, train=True, num_threads=threads
+                    )
+                    rate = measure(pipe, args.batches)
+                    key = "auto" if threads == 0 else str(threads)
+                    out["tfdata_img_per_sec"][key] = round(rate, 1)
+                    print(f"tfdata({key}): {rate:8.1f} img/s", flush=True)
+        except Exception as e:  # pragma: no cover - tf env quirks
+            out["tfdata_error"] = repr(e)
+            print(f"tfdata failed: {e!r}", flush=True)
+
     best_1w = out["processes_img_per_sec"].get("1", 1.0)
     out["per_worker_img_per_sec"] = best_1w
     out["workers_to_saturate_device"] = int(
@@ -125,7 +147,11 @@ def main():
         f"~{out['workers_to_saturate_device']} workers of the measured "
         "per-worker rate saturates the device step rate. The process "
         "pipeline exists because the thread fallback is GIL-bound and "
-        "cannot scale past ~1 core regardless of host size."
+        "cannot scale past ~1 core regardless of host size. The tfdata "
+        "engine (default via --input-backend auto) does all decode/"
+        "augment inside tf.data's C++ threadpool, so it scales with "
+        "host cores in ONE process — the standard JAX-on-TPU-pod input "
+        "recipe."
     )
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
