@@ -409,11 +409,25 @@ def _mp_build_batch(task):
     return images, labels
 
 
-def tfdata_available() -> bool:
-    """True when tensorflow is importable (tf.data backend usable)."""
-    import importlib.util
+_TF_AVAILABLE = None
 
-    return importlib.util.find_spec("tensorflow") is not None
+
+def tfdata_available() -> bool:
+    """True when tensorflow actually IMPORTS (tf.data backend usable).
+
+    find_spec alone is not enough: an installed-but-broken tensorflow
+    (ABI mismatch) would pass the check and then blow up minutes into a
+    run at the first epoch. Importing here costs a few seconds once and
+    makes backend='auto' fall back to mp, and an explicit
+    --input-backend tfdata fail before any model build."""
+    global _TF_AVAILABLE
+    if _TF_AVAILABLE is None:
+        try:
+            _import_tf()
+            _TF_AVAILABLE = True
+        except Exception:
+            _TF_AVAILABLE = False
+    return _TF_AVAILABLE
 
 
 _TF = None
@@ -503,12 +517,10 @@ class TFDataImageFolderPipeline(ImageFolderPipeline):
         )
         self.num_threads = num_threads
         self.prefetch_batches = prefetch_batches
-        self._paths = np.array([p for p, _ in folder.samples])
-        self._labels = np.array([l for _, l in folder.samples], np.int64)
         # built lazily ONCE: constant path/label tables shared by every
         # epoch's graph (on ImageNet the path table is ~100MB of strings
-        # — re-materializing it per epoch would churn host memory), plus
-        # a single traced map function.
+        # — re-materializing it per epoch would churn host memory; no
+        # numpy copy is retained either), plus a single traced map fn.
         self._tables = None
         self._map_fn = None
 
@@ -565,8 +577,10 @@ class TFDataImageFolderPipeline(ImageFolderPipeline):
         tf = _import_tf()
         if self._tables is None:
             self._tables = (
-                tf.constant(self._paths),
-                tf.constant(self._labels),
+                tf.constant(np.array([p for p, _ in self.folder.samples])),
+                tf.constant(
+                    np.array([l for _, l in self.folder.samples], np.int64)
+                ),
             )
             paths_t, labels_t = self._tables
 
