@@ -331,9 +331,10 @@ def worker_main(args) -> None:
     # and KERNELS_r04.json. "dot" is the only implementation.
 
 
-def _probe_backend(timeout_s: float) -> bool:
-    """Cheap TPU-reachability probe: can a fresh process enumerate
-    devices and fence one tiny computation within ``timeout_s``?
+def _probe_backend(timeout_s: float):
+    """Cheap TPU-reachability probe → (ok, failure_detail). Can a fresh
+    process enumerate devices and fence one tiny computation within
+    ``timeout_s``?
 
     The attached chip arrives over a remote PJRT tunnel that flaps for
     hours at a time; when it is down, backend init HANGS rather than
@@ -361,8 +362,13 @@ def _probe_backend(timeout_s: float) -> bool:
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        return False
-    return "PROBE_OK" in (proc.stdout or "")
+        return False, f"no reachable device within {timeout_s:.0f}s (hang)"
+    if "PROBE_OK" in (proc.stdout or ""):
+        return True, ""
+    return False, (
+        f"probe exited rc={proc.returncode}: "
+        + (proc.stderr or proc.stdout or "")[-300:].strip()
+    )
 
 
 def _stale_evidence_fallback(err: str):
@@ -430,15 +436,14 @@ def main() -> None:
 
     err_tail = ""
     for attempt in range(args.attempts):
-        if args.probe_timeout > 0 and not _probe_backend(args.probe_timeout):
-            err_tail = (
-                f"attempt {attempt + 1}: backend probe found no "
-                f"reachable device within {args.probe_timeout:.0f}s"
-            )
-            print(f"[bench] {err_tail}", file=sys.stderr)
-            if attempt < args.attempts - 1:
-                time.sleep(min(120.0, 30.0 * (attempt + 1)))
-            continue
+        if args.probe_timeout > 0:
+            ok, detail = _probe_backend(args.probe_timeout)
+            if not ok:
+                err_tail = f"attempt {attempt + 1}: backend probe failed: {detail}"
+                print(f"[bench] {err_tail}", file=sys.stderr)
+                if attempt < args.attempts - 1:
+                    time.sleep(min(120.0, 30.0 * (attempt + 1)))
+                continue
         cmd = [
             sys.executable, os.path.abspath(__file__), "--worker",
             "--batch", str(args.batch), "--iters", str(args.iters),

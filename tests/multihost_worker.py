@@ -1,0 +1,119 @@
+"""Worker for tests/test_multihost.py — one simulated POD HOST.
+
+Run as ``python multihost_worker.py <proc_id> <num_procs> <port> <dir>``.
+Each process owns 4 virtual CPU devices and joins a real
+``jax.distributed`` cluster (GRPC coordinator, exactly the multi-host
+bring-up a TPU pod uses — reference analogue: NCCL init_process_group,
+``train.py:248``). The global mesh is DP x TP2, so with 2 processes the
+'model'-sharded kernels span BOTH hosts: every leaf is then only
+partially addressable and the collective Orbax checkpoint path is the
+only legal one.
+
+Flow: disjoint per-host batches (host_shard_indices) -> global arrays
+(shard_batch's multi-process branch) -> 2 jitted DP+TP train steps ->
+collective save -> collective restore -> 1 more step. Prints
+``LOSS <step> <value>`` lines (the parent asserts they are finite and
+bit-identical across processes) and ``MH_WORKER_OK`` at the end.
+"""
+
+import os
+import sys
+
+proc_id, num_procs, port, workdir = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=num_procs,
+    process_id=proc_id,
+)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from bdbnn_tpu.data.pipeline import host_shard_indices  # noqa: E402
+from bdbnn_tpu.models import conv_weight_paths  # noqa: E402
+from bdbnn_tpu.models.resnet import BiResNet  # noqa: E402
+from bdbnn_tpu.parallel import (  # noqa: E402
+    create_sharded_state,
+    jit_train_step,
+    make_mesh,
+    shard_batch,
+)
+from bdbnn_tpu.train import (  # noqa: E402
+    StepConfig,
+    TrainState,
+    make_optimizer,
+    make_train_step,
+)
+from bdbnn_tpu.utils.checkpoint import (  # noqa: E402
+    load_checkpoint,
+    save_checkpoint,
+    state_is_distributed,
+)
+
+assert jax.process_count() == num_procs, jax.process_count()
+assert jax.device_count() == 4 * num_procs
+
+mesh = make_mesh(jax.devices(), model_parallel=2)
+
+model = BiResNet(
+    stage_sizes=(1, 1), num_classes=10, width=8,
+    stem="cifar", variant="cifar", act="hardtanh",
+)
+variables = model.init(
+    jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=True
+)
+paths = conv_weight_paths(variables["params"])
+cfg = StepConfig(
+    w_kurtosis=True,
+    kurt_paths=tuple(paths[1:]),
+    kurt_targets=(1.8,) * len(paths[1:]),
+    kurtosis_mode="avg",
+    w_lambda_kurtosis=1.0,
+)
+tx = make_optimizer(
+    variables["params"], dataset="cifar10", lr=0.05, epochs=3,
+    steps_per_epoch=2,
+)
+state = create_sharded_state(mesh, variables, tx, TrainState)
+step = jit_train_step(make_train_step(model, tx, cfg))
+
+# Disjoint per-host slice of a shared deterministic 16-sample epoch —
+# the DistributedSampler replacement, exercised across REAL processes.
+full_x = np.random.default_rng(0).normal(size=(16, 16, 16, 3)).astype(np.float32)
+full_y = np.random.default_rng(1).integers(0, 10, size=(16,))
+idx = host_shard_indices(
+    16, 0, seed=0, shuffle=True, host_id=proc_id, num_hosts=num_procs
+)
+gx, gy = shard_batch(mesh, full_x[idx], full_y[idx])
+
+tk = (jnp.float32(1.0), jnp.float32(1.0))
+gate = jnp.float32(1.0)
+for i in range(2):
+    state, metrics = step(state, (gx, gy), tk, gate)
+    print(f"LOSS {i} {float(metrics['loss']):.10f}", flush=True)
+
+# TP2 over 2 hosts: kernels sharded over 'model' span both processes
+assert state_is_distributed(state), "expected partially-addressable state"
+save_checkpoint(
+    workdir, state, epoch=0, arch="tiny", best_acc1=0.0, is_best=False
+)
+restored = load_checkpoint(workdir, state)
+assert restored["epoch"] == 1 and restored["arch"] == "tiny"
+
+state2, metrics2 = step(restored["state"], (gx, gy), tk, gate)
+print(f"LOSS post-restore {float(metrics2['loss']):.10f}", flush=True)
+assert np.isfinite(float(metrics2["loss"]))
+print("MH_WORKER_OK", flush=True)

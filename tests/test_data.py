@@ -186,9 +186,14 @@ class TestTFDataImageFolderPipeline:
     same shard/batch/determinism contract as the mp pipeline, decode +
     augment in TF's C++ threadpool."""
 
+    # collection-cheap check (find_spec, not a real TF import — the
+    # heavyweight import-proving tfdata_available() would load TF during
+    # pytest collection for every run, including the fast tier)
     pytestmark = pytest.mark.skipif(
-        not __import__("bdbnn_tpu.data", fromlist=["tfdata_available"])
-        .tfdata_available(),
+        __import__("importlib.util", fromlist=["find_spec"]).find_spec(
+            "tensorflow"
+        )
+        is None,
         reason="tensorflow not installed",
     )
 

@@ -68,6 +68,18 @@ class TestFitSmoke:
         )
         assert np.isfinite(res["best_acc1"])
 
+    def test_evaluate_only_mode(self, tmp_path):
+        """-e/--evaluate (reference train.py:376-379): restore a
+        checkpoint, run ONE validation pass, return {'acc1'} without
+        training."""
+        fit(_cfg(tmp_path))
+        runs = list((tmp_path / "log").rglob("checkpoint"))
+        assert runs
+        res = fit(
+            _cfg(tmp_path, evaluate=True, resume=str(runs[0].parent))
+        )
+        assert set(res) == {"acc1"} and np.isfinite(res["acc1"])
+
     def test_missing_data_dir_is_hard_error(self, tmp_path):
         cfg = _cfg(tmp_path, synthetic=False, data=str(tmp_path / "nope"))
         with pytest.raises(FileNotFoundError, match="not found"):
