@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kurtepoch", type=int, default=0)
     p.add_argument("--twoblock", action="store_true")
     p.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize residual blocks (jax.checkpoint): less "
+        "activation HBM, larger per-chip batches; numerically identity",
+    )
+    p.add_argument(
         "--dataset", default="cifar10",
         choices=["cifar10", "cifar100", "imagenet"],
     )
@@ -161,6 +166,7 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         custom_resnet=args.custom_resnet,
         pretrained=args.pretrained,
         twoblock=args.twoblock,
+        remat=args.remat,
         epochs=args.epochs,
         start_epoch=args.start_epoch,
         batch_size=args.batch_size,

@@ -46,6 +46,10 @@ class RunConfig:
     # package): alternate the two binary block types (react / step2)
     # through the net — see BiResNet.twoblock
     twoblock: bool = False
+    # rematerialize residual blocks (jax.checkpoint): ~1/3 more FLOPs
+    # for O(depth) less activation HBM -> larger per-chip batches on
+    # memory-bound shapes; numerically identity. TPU-native extra.
+    remat: bool = False
     # schedule
     # optimizer policy override: "" = reference dataset keying
     # (CIFAR -> sgd-cosine, ImageNet -> adam-linear, train.py:316-336)

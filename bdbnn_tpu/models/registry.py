@@ -43,7 +43,7 @@ def resolve_dtype(dtype):
 
 
 def _make_cifar(name, stage_sizes, width, variant, act, num_classes,
-                dtype=None, twoblock=False):
+                dtype=None, twoblock=False, remat=False):
     return BiResNet(
         stage_sizes=stage_sizes,
         num_classes=num_classes,
@@ -53,11 +53,13 @@ def _make_cifar(name, stage_sizes, width, variant, act, num_classes,
         act=act,
         dtype=resolve_dtype(dtype),
         twoblock=twoblock,
+        remat=remat,
     )
 
 
 def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000,
-                   pretrained=False, dtype=None, twoblock=False):
+                   pretrained=False, dtype=None, twoblock=False,
+                   remat=False):
     # ``pretrained`` accepted for reference-API parity (train.py:285-288);
     # the actual weight loading goes through create_model's caller via
     # bdbnn_tpu.models.torch_import (no network egress in this image).
@@ -71,13 +73,20 @@ def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000,
         act=act,
         dtype=resolve_dtype(dtype),
         twoblock=twoblock,
+        remat=remat,
     )
 
 
-def _make_vgg(num_classes, variant="cifar", dtype=None, twoblock=False):
+def _make_vgg(num_classes, variant="cifar", dtype=None, twoblock=False,
+              remat=False):
     if twoblock:
         raise ValueError(
             "--twoblock mixes ResNet block types; vgg_small has no blocks"
+        )
+    if remat:
+        raise ValueError(
+            "--remat rematerializes ResNet residual blocks; vgg_small "
+            "has none (its activations are small — remat buys nothing)"
         )
     return VGGSmallBinary(
         num_classes=num_classes, variant=variant, dtype=resolve_dtype(dtype)

@@ -116,7 +116,15 @@ class BiBasicBlock(nn.Module):
     dtype: Any = None
 
     @nn.compact
-    def __call__(self, x: Array, *, train: bool = True, tk=None) -> Array:
+    def __call__(self, x: Array, train: bool = True, tk=None) -> Array:
+        # train/tk accept positional calls: BiResNet's remat wrapper
+        # marks train static by argnum (nn.remat static_argnums). The
+        # guard keeps block(x, tk) misuse loud now that train binds
+        # positionally.
+        assert isinstance(train, bool), (
+            f"train must be a bool, got {type(train).__name__} — "
+            "did you pass tk positionally as the second argument?"
+        )
         if self.variant == "float":
             return self._float_forward(x, train=train)
         conv_cls = _CONV_CLASSES[self.variant]
@@ -217,6 +225,13 @@ class BiResNet(nn.Module):
     # binary-conv families the reference imports at train.py:30-31), with
     # the partner's matching activation. float twins ignore it.
     twoblock: bool = False
+    # rematerialize each residual block on the backward pass
+    # (jax.checkpoint via nn.remat): activations are recomputed instead
+    # of stored, trading ~1/3 more FLOPs for O(depth) less live HBM —
+    # the standard TPU recipe for raising per-chip batch on
+    # memory-bound shapes (224x224 stem activations dominate).
+    # Numerically identity; see tests/test_models.py::TestRemat.
+    remat: bool = False
 
     _TWOBLOCK_PARTNER = {"react": "step2", "step2": "react", "cifar": "react"}
     _VARIANT_ACT = {"react": "rprelu", "step2": "hardtanh", "cifar": "hardtanh"}
@@ -247,6 +262,13 @@ class BiResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem: {self.stem!r}")
 
+        # static_argnums=(2,): `train` (0=module, 1=x) selects python
+        # branches (BN mode) and must stay static under jax.checkpoint
+        block_cls = (
+            nn.remat(BiBasicBlock, static_argnums=(2,))
+            if self.remat
+            else BiBasicBlock
+        )
         block_idx = 0
         for s, num_blocks in enumerate(self.stage_sizes):
             features = self.width * (2**s)
@@ -256,14 +278,14 @@ class BiResNet(nn.Module):
                 if self.twoblock and variant != "float" and block_idx % 2 == 1:
                     variant = self._TWOBLOCK_PARTNER[variant]
                     act = self._VARIANT_ACT[variant]
-                x = BiBasicBlock(
+                x = block_cls(
                     features=features,
                     strides=strides,
                     variant=variant,
                     act=act,
                     dtype=self.dtype,
                     name=f"layer{s + 1}_{b}",
-                )(x, train=train, tk=tk)
+                )(x, train, tk)
                 block_idx += 1
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool

@@ -386,7 +386,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
 
     mesh = make_mesh(model_parallel=cfg.model_parallel)
     model = create_model(
-        cfg.arch, cfg.dataset, dtype=cfg.dtype, twoblock=cfg.twoblock
+        cfg.arch, cfg.dataset, dtype=cfg.dtype, twoblock=cfg.twoblock,
+        remat=cfg.remat,
     )
     rng = jax.random.PRNGKey(cfg.seed or 0)
     variables = model.init(

@@ -73,6 +73,9 @@ def main():
                     default="adam-linear")
     ap.add_argument("--out", default="ACCURACY_r04.json")
     ap.add_argument("--platform", default="", help="force jax platform")
+    # the BASELINE north-star metric shape ("wall-clock to 63% top-1"):
+    # record seconds until val top-1 first reaches this percentage
+    ap.add_argument("--target-acc", type=float, default=90.0)
     args = ap.parse_args()
 
     if args.platform:
@@ -103,6 +106,7 @@ def main():
             seed=0,
             print_freq=10,
             log_path=log_root,
+            target_acc=args.target_acc,
         )
         t0 = time.time()
         result = fit(cfg)
@@ -152,6 +156,8 @@ def main():
         "batch_size": args.batch,
         "opt_policy": args.opt_policy,
         "wall_seconds": round(wall, 1),
+        "target_acc": args.target_acc,
+        "time_to_target_s": result.get("time_to_target_s"),
         "best_val_top1": result.get("best_acc1"),
         "best_epoch": result.get("best_epoch"),
         "val_top1_curve": [round(v, 3) for v in curve["Val Acc1"]],

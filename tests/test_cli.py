@@ -109,13 +109,14 @@ class TestTpuNativeFlags:
         cfg = parse(
             [
                 "/data", "--model-parallel", "2", "--distributed-init",
-                "--dtype", "bfloat16", "--device-normalize",
+                "--dtype", "bfloat16", "--device-normalize", "--remat",
                 "--target-acc", "63.0", "--opt-policy", "adam-linear",
                 "--profile-dir", "/tmp/prof",
             ]
         )
         assert cfg.model_parallel == 2 and cfg.distributed_init
         assert cfg.dtype == "bfloat16" and cfg.device_normalize
+        assert cfg.remat
         assert cfg.target_acc == 63.0
         assert cfg.opt_policy == "adam-linear"
 

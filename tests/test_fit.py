@@ -43,7 +43,9 @@ class TestFitSmoke:
         res2 = fit(_cfg(tmp_path, epochs=2, resume=str(runs[0].parent)))
         assert np.isfinite(res2["best_acc1"])
 
-    def test_kurtosis_ede_run(self, tmp_path):
+    def test_kurtosis_ede_remat_run(self, tmp_path):
+        # remat=True rides along: the rematerialized blocks must work
+        # under the full jitted/donated train step, not just raw grads
         res = fit(
             _cfg(
                 tmp_path,
@@ -51,6 +53,7 @@ class TestFitSmoke:
                 ede=True,
                 diffkurt=False,
                 kurtepoch=0,
+                remat=True,
             )
         )
         assert np.isfinite(res["best_acc1"])

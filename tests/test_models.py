@@ -276,3 +276,60 @@ class TestTwoBlock:
     def test_vgg_rejects_twoblock(self):
         with pytest.raises(ValueError):
             create_model("vgg_small", "cifar10", twoblock=True)
+
+
+class TestRemat:
+    """--remat (jax.checkpoint over residual blocks): must be a
+    numerical IDENTITY up to float32 recompute reassociation (the
+    checkpointed backward re-executes blocks under different fusion, so
+    last-ulp differences accumulate; observed max rel diff ~1e-5 over
+    20 layers) — while storing O(depth) fewer activations."""
+
+    def test_remat_is_identity_for_loss_and_grads(self):
+        import numpy as np
+
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(4, 32, 32, 3)),
+            jnp.float32,
+        )
+        tk = (jnp.float32(1.2), jnp.float32(3.0))
+        plain = create_model("resnet20", "cifar10")
+        rem = create_model("resnet20", "cifar10", remat=True)
+        v = plain.init(jax.random.PRNGKey(0), x[:1], train=True)
+
+        def loss_fn(model, params):
+            out, upd = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, train=True, tk=tk, mutable=["batch_stats"],
+            )
+            return jnp.mean(out**2), upd
+
+        (l0, u0), g0 = jax.value_and_grad(
+            lambda p: loss_fn(plain, p), has_aux=True
+        )(v["params"])
+        (l1, u1), g1 = jax.value_and_grad(
+            lambda p: loss_fn(rem, p), has_aux=True
+        )(v["params"])
+        assert jnp.allclose(l0, l1, rtol=1e-6)
+
+        def close(a, b):
+            # per-leaf scale-relative tolerance: recompute reassociation
+            # leaves small elements of a leaf with unbounded RELATIVE
+            # error when siblings are 1000x larger (cancellation), so
+            # atol keys on the leaf's own magnitude
+            scale = float(np.max(np.abs(b))) or 1.0
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4 * scale)
+
+        jax.tree_util.tree_map(close, g0, g1)
+        jax.tree_util.tree_map(close, u0, u1)
+
+    def test_remat_param_structure_unchanged(self):
+        """Checkpoints/teachers must load identically: remat cannot
+        change module naming or shapes."""
+        a = _init(create_model("resnet20", "cifar10"), 32)
+        b = _init(create_model("resnet20", "cifar10", remat=True), 32)
+        assert jax.tree_util.tree_structure(a) == jax.tree_util.tree_structure(b)
+
+    def test_vgg_rejects_remat(self):
+        with pytest.raises(ValueError, match="remat"):
+            create_model("vgg_small", "cifar10", remat=True)
