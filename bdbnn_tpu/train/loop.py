@@ -174,11 +174,17 @@ def build_datasets(cfg: RunConfig):
             )
         if backend == "mp" and cfg.workers <= 0:
             backend = "threads"
-        if backend == "tfdata":
-            # tf.data autotunes its C++ pool to the host (that is the
-            # point of this backend); -j sizes the mp/threads backends.
-            # A private fixed-size pool remains reachable via the class.
-            mk_folder = lambda split, train: TFDataImageFolderPipeline(
+        # tfdata autotunes its C++ pool to the host (that is the point
+        # of this backend); -j sizes the mp backend. A private
+        # fixed-size tf.data pool remains reachable via the class.
+        pipe_cls, extra = {
+            "tfdata": (TFDataImageFolderPipeline, {}),
+            "mp": (MPImageFolderPipeline, {"num_workers": cfg.workers}),
+            "threads": (ImageFolderPipeline, {}),
+        }[backend]
+
+        def mk_folder(split, train):
+            return pipe_cls(
                 ImageFolder(os.path.join(cfg.data, split)),
                 per_host_batch,
                 train=train,
@@ -186,28 +192,9 @@ def build_datasets(cfg: RunConfig):
                 host_id=host_id,
                 num_hosts=num_hosts,
                 device_normalize=cfg.device_normalize,
+                **extra,
             )
-        elif backend == "mp":
-            mk_folder = lambda split, train: MPImageFolderPipeline(
-                ImageFolder(os.path.join(cfg.data, split)),
-                per_host_batch,
-                train=train,
-                seed=cfg.seed or 0,
-                host_id=host_id,
-                num_hosts=num_hosts,
-                num_workers=cfg.workers,
-                device_normalize=cfg.device_normalize,
-            )
-        else:
-            mk_folder = lambda split, train: ImageFolderPipeline(
-                ImageFolder(os.path.join(cfg.data, split)),
-                per_host_batch,
-                train=train,
-                seed=cfg.seed or 0,
-                host_id=host_id,
-                num_hosts=num_hosts,
-                device_normalize=cfg.device_normalize,
-            )
+
         train_pipe = mk_folder("train", True)
         val_pipe = mk_folder("val", False)
     except (FileNotFoundError, OSError) as e:

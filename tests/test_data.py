@@ -111,26 +111,29 @@ class TestPipeline:
         assert len(np.unique(allseen)) == 64
 
 
+@pytest.fixture(scope="module")
+def jpeg_folder(tmp_path_factory):
+    """2 classes x 12 JPEGs, 64x80 — shared by every ImageFolder
+    pipeline test class."""
+    from PIL import Image
+
+    from bdbnn_tpu.data import ImageFolder
+
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.default_rng(0)
+    for cls in ("a", "b"):
+        d = root / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(12):
+            arr = rng.integers(0, 255, size=(64, 80, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i:03d}.jpg")
+    return ImageFolder(str(root / "train"))
+
+
 class TestMPImageFolderPipeline:
     """The pod-grade multiprocess ImageNet feed (VERDICT r3 #4):
     worker-count-invariant determinism + parity of the shard/batch
     contract with the thread fallback."""
-
-    @pytest.fixture(scope="class")
-    def jpeg_folder(self, tmp_path_factory):
-        from PIL import Image
-
-        from bdbnn_tpu.data import ImageFolder
-
-        root = tmp_path_factory.mktemp("imgs")
-        rng = np.random.default_rng(0)
-        for cls in ("a", "b"):
-            d = root / "train" / cls
-            d.mkdir(parents=True)
-            for i in range(12):
-                arr = rng.integers(0, 255, size=(64, 80, 3), dtype=np.uint8)
-                Image.fromarray(arr).save(d / f"{i:03d}.jpg")
-        return ImageFolder(str(root / "train"))
 
     def test_deterministic_across_worker_counts(self, jpeg_folder):
         from bdbnn_tpu.data import MPImageFolderPipeline
@@ -196,22 +199,6 @@ class TestTFDataImageFolderPipeline:
         is None,
         reason="tensorflow not installed",
     )
-
-    @pytest.fixture(scope="class")
-    def jpeg_folder(self, tmp_path_factory):
-        from PIL import Image
-
-        from bdbnn_tpu.data import ImageFolder
-
-        root = tmp_path_factory.mktemp("tfimgs")
-        rng = np.random.default_rng(7)
-        for cls in ("a", "b"):
-            d = root / "train" / cls
-            d.mkdir(parents=True)
-            for i in range(12):
-                arr = rng.integers(0, 255, size=(64, 80, 3), dtype=np.uint8)
-                Image.fromarray(arr).save(d / f"{i:03d}.jpg")
-        return ImageFolder(str(root / "train"))
 
     def test_train_shapes_dtype_and_determinism(self, jpeg_folder):
         from bdbnn_tpu.data import TFDataImageFolderPipeline
