@@ -25,9 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("data", nargs="?", default="", help="dataset directory")
     p.add_argument("-a", "--arch", default="resnet18")
     p.add_argument(
-        "-j", "--workers", type=int, default=4,
-        help="decode workers for the mp/threads input backends "
-        "(tfdata autotunes its C++ pool to the host)",
+        "-j", "--workers", type=int, default=None,
+        help="decode workers (default 4) for the mp/threads input "
+        "backends; under tfdata an EXPLICIT -j pins a private "
+        "fixed-size C++ threadpool (otherwise tf.data autotunes)",
     )
     p.add_argument("--epochs", type=int, default=90)
     p.add_argument("--start-epoch", type=int, default=0)

@@ -27,7 +27,9 @@ class RunConfig:
     # data
     data: str = ""  # dataset dir (positional in the reference)
     dataset: str = "cifar10"  # cifar10 | cifar100 | imagenet
-    workers: int = 4
+    # None = unset (mp/threads default to 4 decode workers; tfdata
+    # autotunes). An EXPLICIT value — even 4 — pins the tfdata pool.
+    workers: Optional[int] = None
     # ImageNet input engine: tfdata (tf.data C++ threadpool — the
     # BASELINE.json-named pod-grade path), mp (worker processes, ↔ the
     # reference's 16 DataLoader workers), threads (in-process fallback).

@@ -545,7 +545,12 @@ class TFDataImageFolderPipeline(ImageFolderPipeline):
                 use_image_if_no_bounding_boxes=True,
             )
             img = tf.slice(img, begin, crop)
-            img = tf.image.resize(img, (size, size), method="bilinear")
+            # antialias=True: torchvision/PIL bilinear downscale
+            # antialiases; tf defaults to antialias=False, a systematic
+            # eval-protocol deviation (ADVICE r4)
+            img = tf.image.resize(
+                img, (size, size), method="bilinear", antialias=True
+            )
             img = tf.image.stateless_random_flip_left_right(
                 img, seed=seed + tf.constant([0, 1])
             )
@@ -561,6 +566,7 @@ class TFDataImageFolderPipeline(ImageFolderPipeline):
                     tf.cast(tf.round(w * scale), tf.int32),
                 ),
                 method="bilinear",
+                antialias=True,
             )
             img = tf.image.resize_with_crop_or_pad(img, size, size)
         if self.device_normalize:

@@ -120,11 +120,13 @@ class BiBasicBlock(nn.Module):
         # train/tk accept positional calls: BiResNet's remat wrapper
         # marks train static by argnum (nn.remat static_argnums). The
         # guard keeps block(x, tk) misuse loud now that train binds
-        # positionally.
-        assert isinstance(train, bool), (
-            f"train must be a bool, got {type(train).__name__} — "
-            "did you pass tk positionally as the second argument?"
-        )
+        # positionally; a TypeError (not assert) so it survives
+        # ``python -O`` (ADVICE r4).
+        if not isinstance(train, bool):
+            raise TypeError(
+                f"train must be a bool, got {type(train).__name__} — "
+                "did you pass tk positionally as the second argument?"
+            )
         if self.variant == "float":
             return self._float_forward(x, train=train)
         conv_cls = _CONV_CLASSES[self.variant]
@@ -246,13 +248,16 @@ class BiResNet(nn.Module):
             )(x)
             x = _batch_norm(train, "bn1", self.dtype)(x)
             x = nn.relu(x)
-            # torch MaxPool2d(3, stride=2, padding=1)
-            x = jnp.pad(
-                x,
-                ((0, 0), (1, 1), (1, 1), (0, 0)),
-                constant_values=-jnp.inf,
+            # torch MaxPool2d(3, stride=2, padding=1) — padding goes to
+            # lax.reduce_window NATIVELY (its init value is -inf, so
+            # identical math) instead of materializing a -inf-padded
+            # copy; the explicit jnp.pad cost a separate pad HLO + a
+            # select_and_scatter backward over the enlarged buffer
+            # (~10% of device step time in profiles/r04/PROFILE_r04).
+            x = nn.max_pool(
+                x, window_shape=(3, 3), strides=(2, 2),
+                padding=((1, 1), (1, 1)),
             )
-            x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
         elif self.stem == "cifar":
             x = FloatConv(
                 self.width, kernel_size=(3, 3), strides=(1, 1), name="conv1"

@@ -73,6 +73,7 @@ from bdbnn_tpu.utils import (
     Throughput,
     format_eta,
     load_checkpoint,
+    load_variables,
     make_log_dir,
     save_checkpoint,
     setup_logger,
@@ -159,11 +160,12 @@ def build_datasets(cfg: RunConfig):
         # auto = tfdata when tensorflow is present, else mp/threads by
         # --workers.
         backend = cfg.input_backend
+        workers = 4 if cfg.workers is None else cfg.workers
         if backend == "auto":
             backend = (
                 "tfdata"
                 if tfdata_available()
-                else ("mp" if cfg.workers > 0 else "threads")
+                else ("mp" if workers > 0 else "threads")
             )
         elif backend == "tfdata" and not tfdata_available():
             # fail BEFORE model build/compile, not minutes later at the
@@ -172,14 +174,26 @@ def build_datasets(cfg: RunConfig):
                 "--input-backend tfdata requested but tensorflow is not "
                 "importable here; install it or use --input-backend mp"
             )
-        if backend == "mp" and cfg.workers <= 0:
+        if backend == "mp" and workers <= 0:
             backend = "threads"
         # tfdata autotunes its C++ pool to the host (that is the point
-        # of this backend); -j sizes the mp backend. A private
-        # fixed-size tf.data pool remains reachable via the class.
+        # of this backend) — but an EXPLICIT -j (cfg.workers not None,
+        # even -j 4) pins a private fixed-size tf.data threadpool, so a
+        # user throttling host threads on a shared machine actually
+        # gets the throttle (ADVICE r4: -j was silently ignored under
+        # tfdata).
         pipe_cls, extra = {
-            "tfdata": (TFDataImageFolderPipeline, {}),
-            "mp": (MPImageFolderPipeline, {"num_workers": cfg.workers}),
+            "tfdata": (
+                TFDataImageFolderPipeline,
+                # explicit -j pins a private pool; 0 would mean "shared
+                # autotuned pool" to tf.data (pipeline.py num_threads
+                # contract) — the opposite of an explicit throttle — so
+                # an explicit -j <= 0 clamps to the minimum pool of 1
+                {}
+                if cfg.workers is None
+                else {"num_threads": max(cfg.workers, 1)},
+            ),
+            "mp": (MPImageFolderPipeline, {"num_workers": workers}),
             "threads": (ImageFolderPipeline, {}),
         }[backend]
 
@@ -317,8 +331,14 @@ def build_teacher(cfg: RunConfig, image_size: int):
     )
     if cfg.resume_teacher:
         # NB: the reference checks the WRONG flag here (args.resume,
-        # train.py:260 — Appendix B #7); fixed.
-        loaded = load_torch_checkpoint(cfg.resume_teacher)
+        # train.py:260 — Appendix B #7); fixed. Accepts EITHER a
+        # reference-format torch file OR a native (Orbax) run dir, so a
+        # fit()-trained float twin can teach without leaving the
+        # framework (reference teachers were torch-only, train.py:265).
+        if os.path.isdir(cfg.resume_teacher):
+            loaded = load_variables(cfg.resume_teacher)
+        else:
+            loaded = load_torch_checkpoint(cfg.resume_teacher)
         variables = {
             "params": _overlay(
                 variables["params"], loaded["params"],
@@ -654,6 +674,7 @@ def _train_epoch(
     loss_m = Mean("Loss", "{:.4e}")
     top1_m = Mean("Acc@1", "{:6.2f}")
     top5_m = Mean("Acc@5", "{:6.2f}")
+    comp_m: Dict[str, Mean] = {}
     thr = Throughput()
     progress = ProgressLog(steps_per_epoch, logger, prefix=f"Epoch: [{epoch}]")
     n_chips = max(jax.device_count(), 1)
@@ -679,8 +700,10 @@ def _train_epoch(
             trace_active = False
 
         if step_idx % cfg.print_freq == 0:
+            interval_steps = devmet.pending_steps
             sums = devmet.drain()  # the ONE host sync per interval
             n = max(sums["count"], 1.0)
+            _add_component_means(comp_m, sums, interval_steps)
             # loss_sum is example-weighted at the step (loss × count), so
             # interval and epoch means are exact regardless of interval
             # length (VERDICT r3 #6: /steps skewed short final intervals)
@@ -711,8 +734,10 @@ def _train_epoch(
 
     # final partial interval + epoch means
     if devmet.pending_steps:
+        interval_steps = devmet.pending_steps
         sums = devmet.drain()
         n = max(sums["count"], 1.0)
+        _add_component_means(comp_m, sums, interval_steps)
         loss_m.add(sums["loss_sum"] / n, n)
         top1_m.add(100.0 * sums["top1"] / n, n)
         top5_m.add(100.0 * sums["top5"] / n, n)
@@ -722,7 +747,25 @@ def _train_epoch(
     writer.add_scalar("Train Acc1", top1_m.mean, epoch)
     writer.add_scalar("Train Acc5", top5_m.mean, epoch)
     writer.add_scalar("Train img/s/chip", thr.cumulative / n_chips, epoch)
+    # loss components (CE / layer-KL / logit-KL / kurt / L2 / WR as
+    # configured) — auditable per-epoch evidence that every term of the
+    # 4-term TS loss (reference train.py:596-611) stays finite
+    for key, meter in sorted(comp_m.items()):
+        writer.add_scalar(f"Train {key}", meter.mean, epoch)
     return state
+
+
+def _add_component_means(comp_m, sums, interval_steps):
+    """Fold drained per-step-mean loss-component sums into host meters
+    (``loss_ce`` / ``loss_kl*`` / ``loss_kurt`` / ...), weighted by the
+    interval's step count."""
+    if not interval_steps:
+        return
+    for key, val in sums.items():
+        if key.startswith("loss_") and key != "loss_sum":
+            comp_m.setdefault(key, Mean(key)).add(
+                val / interval_steps, interval_steps
+            )
 
 
 def _pad_eval_batch(x, y, batch_size):
@@ -744,14 +787,14 @@ def _validate(eval_step, state, pipe, mesh, logger, writer, epoch,
     ``train.py:677-714``; the reference reduced nothing across ranks).
     Batches are padded to the pipeline batch size and masked, so one
     compiled program serves every step incl. the remainder."""
-    loss_sum = 0.0
-    top1_sum = 0.0
-    top5_sum = 0.0
-    count = 0.0
     bs = pipe.batch_size
     # every host executes exactly pipe.eval_steps() collectives: hosts
     # whose shard ran out feed fully-masked batches (valid = 0) so no
-    # host launches a collective the others never join
+    # host launches a collective the others never join. Per-step sums
+    # accumulate ON DEVICE (lazy jnp adds, mirroring DeviceMetrics) —
+    # one host sync per validation, not per batch (the reference's
+    # .item()-per-batch pattern, train.py:699-706).
+    totals = None
     it = pipe.epoch(0)
     for _ in range(pipe.eval_steps()):
         try:
@@ -763,12 +806,16 @@ def _validate(eval_step, state, pipe, mesh, logger, writer, epoch,
         x, y, valid = _pad_eval_batch(x, y, bs)
         gx, gy, gv = shard_batch(mesh, x, y, valid)
         m = eval_step(state, (gx, gy, gv))
-        m = jax.device_get(m)
-        loss_sum += float(m["loss_sum"])
-        top1_sum += float(m["top1"])
-        top5_sum += float(m["top5"])
-        count += float(m["count"])
-    count = max(count, 1.0)
+        totals = (
+            m
+            if totals is None
+            else {k: totals[k] + v for k, v in m.items()}
+        )
+    fetched = jax.device_get(totals) if totals is not None else {}
+    loss_sum = float(fetched.get("loss_sum", 0.0))
+    top1_sum = float(fetched.get("top1", 0.0))
+    top5_sum = float(fetched.get("top5", 0.0))
+    count = max(float(fetched.get("count", 0.0)), 1.0)
     acc1 = 100.0 * top1_sum / count
     acc5 = 100.0 * top5_sum / count
     logger.info(

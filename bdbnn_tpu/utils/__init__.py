@@ -1,5 +1,9 @@
 from bdbnn_tpu.utils import checkpoint, logging_utils, meters
-from bdbnn_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from bdbnn_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_variables,
+    save_checkpoint,
+)
 from bdbnn_tpu.utils.logging_utils import (
     ScalarWriter,
     make_log_dir,
@@ -20,6 +24,7 @@ __all__ = [
     "logging_utils",
     "meters",
     "load_checkpoint",
+    "load_variables",
     "save_checkpoint",
     "ScalarWriter",
     "make_log_dir",

@@ -145,6 +145,32 @@ def save_checkpoint(
         _barrier("ckpt-post-commit")
 
 
+def load_variables(path: str) -> Dict[str, Any]:
+    """Load ``{'params', 'batch_stats'}`` (host arrays) from a native
+    checkpoint — e.g. to use a ``fit()``-trained float twin as a frozen
+    KD teacher (↔ the reference loading a torch teacher checkpoint,
+    ``train.py:258-277``, but for this framework's own output format).
+
+    ``path`` may be a run dir (``model_best`` preferred over
+    ``checkpoint``), or a specific checkpoint dir. Restores without a
+    template — weights only, no optimizer state placement — so it works
+    for any arch without constructing a TrainState first.
+    """
+    best = os.path.join(path, BEST_NAME)
+    if os.path.isdir(best):
+        path = best
+    payload = _checkpointer().restore(_resolve_ckpt_dir(path))
+    state = payload.get("state", payload) if isinstance(payload, dict) else payload
+    if not isinstance(state, dict) or "params" not in state:
+        raise ValueError(
+            f"{path!r} is not a bdbnn_tpu checkpoint (no state/params)"
+        )
+    return {
+        "params": state["params"],
+        "batch_stats": state.get("batch_stats", {}) or {},
+    }
+
+
 def _resolve_ckpt_dir(path: str) -> str:
     """Accept a run dir or a checkpoint dir; prefer the committed
     checkpoint, falling back to ``.old`` after a mid-save crash."""

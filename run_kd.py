@@ -1,0 +1,252 @@
+"""First end-to-end teacher–student/KD accuracy artifact (VERDICT r4 #1).
+
+The reference's signature workflow is the 4-term teacher–student loss
+(reference ``train.py:556-675``): β·layer-weight-KL + α·logit-KL +
+CE + λ·kurtosis, with a frozen full-precision teacher. This script
+produces the first accuracy evidence for it, fully in-container:
+
+1. **Teacher phase** — train the float twin (``resnet20_float``) on the
+   real digits dataset (same data + split as ACCURACY_r04.json) through
+   the ordinary ``fit()`` path and checkpoint it (native Orbax).
+2. **Distill phase** — BASELINE-config-2-shaped run through ``fit()``:
+   ``imagenet_setting_step_2_ts`` + ``--resume-teacher <native ckpt>``
+   + ``--w-kurtosis``, binary ``resnet20`` student, equal epoch budget
+   to the 97.78% no-KD headline (ACCURACY_r04.json, 100 epochs).
+
+Writes ACCURACY_r05_ts.json with teacher provenance, the per-epoch
+loss-component curves (CE / layer-KL / logit-KL / kurt — all four TS
+terms, finite), and the KD-vs-no-KD comparison at equal budget.
+
+Usage: python run_kd.py [--teacher-epochs 60] [--epochs 100]
+                        [--platform cpu] [--workdir runs_r05/kd]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+
+from run_accuracy import make_digits_npz
+
+
+def _read_curves(log_root, tags):
+    """Curves from the LATEST run under log_root only — a rerun in the
+    same workdir must not merge scalars from a stale crashed run
+    (run dirs are timestamp-named, so lexicographic max = newest)."""
+    paths = sorted(
+        glob.glob(os.path.join(log_root, "**", "scalars.jsonl"),
+                  recursive=True)
+    )
+    if not paths:
+        return {}
+    with open(paths[-1]) as f:
+        scalars = [json.loads(line) for line in f]
+    present = {s["tag"] for s in scalars}
+    return {
+        tag: [
+            s["value"]
+            for s in sorted(
+                (s for s in scalars if s["tag"] == tag),
+                key=lambda s: s["step"],
+            )
+        ]
+        for tag in tags
+        if tag in present
+    }
+
+
+def _find_run_dir(log_root):
+    """fit() nests its run under make_log_dir; find the LATEST dir
+    holding model_best (preferred) or checkpoint — timestamp-named run
+    dirs sort lexicographically, so max = newest (a stale run from an
+    earlier crash in the same workdir must never win)."""
+    for name in ("model_best", "checkpoint"):
+        hits = sorted(
+            glob.glob(os.path.join(log_root, "**", name), recursive=True)
+        )
+        if hits:
+            return os.path.dirname(hits[-1])
+    raise FileNotFoundError(f"no checkpoint under {log_root}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="runs_r05/kd")
+    ap.add_argument("--teacher-epochs", type=int, default=60)
+    ap.add_argument("--teacher-lr", type=float, default=0.001)
+    ap.add_argument("--epochs", type=int, default=100,
+                    help="student budget; 100 = the no-KD headline's")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1,
+                    help="student lr (the no-KD headline's)")
+    ap.add_argument("--alpha", type=float, default=0.9)
+    ap.add_argument("--beta", type=float, default=200.0)
+    ap.add_argument("--temperature", type=float, default=4.0)
+    ap.add_argument("--out", default="ACCURACY_r05_ts.json")
+    ap.add_argument("--platform", default="")
+    args = ap.parse_args()
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from bdbnn_tpu.configs.config import RunConfig
+    from bdbnn_tpu.train.loop import fit
+
+    # Orbax requires absolute checkpoint paths
+    args.workdir = os.path.abspath(args.workdir)
+    os.makedirs(args.workdir, exist_ok=True)
+    data_dir = os.path.join(args.workdir, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    if not os.path.exists(os.path.join(data_dir, "data.npz")):
+        counts = make_digits_npz(data_dir)
+    else:
+        import numpy as np
+
+        z = np.load(os.path.join(data_dir, "data.npz"))
+        counts = {"n_train": len(z["y_train"]), "n_test": len(z["y_test"])}
+
+    # ---- phase 1: float-twin teacher ------------------------------------
+    teacher_root = os.path.join(args.workdir, "teacher")
+    teacher_meta_path = os.path.join(args.workdir, "teacher_meta.json")
+    if os.path.exists(teacher_meta_path):
+        with open(teacher_meta_path) as f:
+            teacher_meta = json.load(f)
+    else:
+        cfg_t = RunConfig(
+            data=data_dir,
+            dataset="cifar10",
+            arch="resnet20_float",
+            epochs=args.teacher_epochs,
+            batch_size=args.batch,
+            lr=args.teacher_lr,
+            opt_policy="adam-linear",
+            seed=0,
+            print_freq=10,
+            log_path=teacher_root,
+        )
+        t0 = time.time()
+        res_t = fit(cfg_t)
+        teacher_meta = {
+            "arch": "resnet20_float",
+            "epochs": args.teacher_epochs,
+            "lr": args.teacher_lr,
+            "opt_policy": "adam-linear",
+            "best_val_top1": res_t["best_acc1"],
+            "best_epoch": res_t["best_epoch"],
+            "wall_seconds": round(time.time() - t0, 1),
+            "ckpt_dir": _find_run_dir(teacher_root),
+        }
+        with open(teacher_meta_path, "w") as f:
+            json.dump(teacher_meta, f, indent=2)
+    print("[run_kd] teacher:", json.dumps(teacher_meta))
+
+    # ---- phase 2: distill the binary student ----------------------------
+    student_root = os.path.join(args.workdir, "student_ts")
+    cfg_s = RunConfig(
+        data=data_dir,
+        dataset="cifar10",
+        arch="resnet20",
+        epochs=args.epochs,
+        batch_size=args.batch,
+        lr=args.lr,
+        opt_policy="adam-linear",
+        w_kurtosis=True,
+        w_kurtosis_target=1.8,
+        w_lambda_kurtosis=1.0,
+        imagenet_setting_step_2_ts=True,
+        arch_teacher="resnet20_float",
+        resume_teacher=teacher_meta["ckpt_dir"],
+        alpha=args.alpha,
+        beta=args.beta,
+        temperature=args.temperature,
+        seed=0,
+        print_freq=10,
+        log_path=student_root,
+        target_acc=90.0,
+    )
+    t0 = time.time()
+    res_s = fit(cfg_s)
+    wall_s = time.time() - t0
+
+    curves = _read_curves(
+        student_root,
+        (
+            "Val Acc1", "Train Acc1", "Train Loss",
+            "Train loss_ce", "Train loss_kl", "Train loss_kl_c",
+            "Train loss_kurt",
+        ),
+    )
+    import math
+
+    components_finite = all(
+        math.isfinite(v)
+        for tag in ("Train loss_ce", "Train loss_kl", "Train loss_kl_c",
+                    "Train loss_kurt")
+        for v in curves.get(tag, [float("nan")])
+    )
+
+    out = {
+        "what": (
+            "first end-to-end teacher-student/KD accuracy artifact: "
+            "float-twin resnet20 teacher trained + checkpointed natively, "
+            "then BASELINE-config-2-shaped distillation of the binary "
+            "resnet20 student through fit() with the full 4-term TS loss "
+            "(beta*layerKL + alpha*logitKL + CE + lambda*kurt, reference "
+            "train.py:556-675) at equal budget to the no-KD headline"
+        ),
+        "dataset": "sklearn digits upsampled to CIFAR layout (same data "
+                   "+ split as ACCURACY_r04.json; no CIFAR binaries / no "
+                   "egress in this container)",
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        **counts,
+        "teacher": teacher_meta,
+        "student": {
+            "arch": "resnet20 (binary)",
+            "epochs": args.epochs,
+            "lr": args.lr,
+            "opt_policy": "adam-linear",
+            "alpha": args.alpha,
+            "beta": args.beta,
+            "temperature": args.temperature,
+            "w_kurtosis_target": 1.8,
+            "wall_seconds": round(wall_s, 1),
+        },
+        "no_kd_reference": {
+            "artifact": "ACCURACY_r04.json",
+            "best_val_top1": 97.77777777777777,
+            "epochs": 100,
+            "note": "same student arch/recipe minus the TS terms",
+        },
+        "best_val_top1": res_s.get("best_acc1"),
+        "best_epoch": res_s.get("best_epoch"),
+        "time_to_target_s": res_s.get("time_to_target_s"),
+        "ts_loss_components_finite": components_finite,
+        "val_top1_curve": [round(v, 3) for v in curves.get("Val Acc1", [])],
+        "train_top1_curve": [
+            round(v, 3) for v in curves.get("Train Acc1", [])
+        ],
+        "loss_component_curves": {
+            tag.replace("Train ", ""): [
+                round(v, 5) for v in curves.get(tag, [])
+            ]
+            for tag in ("Train loss_ce", "Train loss_kl",
+                        "Train loss_kl_c", "Train loss_kurt")
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({k: v for k, v in out.items()
+                      if k not in ("what", "dataset",
+                                   "loss_component_curves")}))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,20 +23,24 @@ class TestStaleEvidenceFallback:
         for key in ("metric", "value", "unit", "vs_baseline"):
             assert key in out
         assert out["metric"] == bench.METRIC
-        assert out["value"] > 0
-        # a consumer must be able to tell this is NOT a fresh run
+        # ADVICE r4 (medium): a consumer reading ONLY the pinned
+        # {metric, value, unit, vs_baseline} contract must see failure
+        assert out["value"] == 0.0
+        assert out["vs_baseline"] == 0.0
         assert out["fresh_run"] is False
         assert "synthetic-error" in out["error"]
         assert os.path.exists(out["evidence"])
+        # the prior measurement rides along under non-contract keys
+        assert out["prior_value"] > 0
         # JSON-serializable end to end
         json.loads(json.dumps(out))
 
-    def test_fallback_value_is_the_conservative_host_fenced_number(self):
+    def test_fallback_prior_is_the_conservative_host_fenced_number(self):
         out = bench._stale_evidence_fallback("e")
         with open(out["evidence"]) as f:
             prof = json.load(f)
-        assert out["value"] == prof["host_fenced_median_img_per_sec"]
-        assert out["value"] <= prof["device_images_per_sec"]
+        assert out["prior_value"] == prof["host_fenced_median_img_per_sec"]
+        assert out["prior_value"] <= prof["device_images_per_sec"]
 
 
 class TestProbe:

@@ -184,3 +184,65 @@ class TestCrashSafety:
         restored = load_checkpoint(str(tmp_path), fresh_template())
         assert restored["epoch"] == 4
         assert restored["best_acc1"] == pytest.approx(7.0)
+
+
+class TestLoadVariables:
+    """``load_variables`` — the template-free weights-only restore that
+    backs native (Orbax) KD teachers (``--resume-teacher <run dir>``,
+    build_teacher in train/loop.py; ↔ the reference's torch-teacher
+    load, train.py:258-277)."""
+
+    def test_roundtrip_params_and_batch_stats(self, tmp_path):
+        from bdbnn_tpu.utils.checkpoint import load_variables
+
+        run, fresh_template = _setup()
+        state, _ = run(fresh_template(), n=2)
+        save_checkpoint(
+            str(tmp_path), state, epoch=0, arch="tiny", best_acc1=1.0,
+            is_best=False,
+        )
+        loaded = load_variables(str(tmp_path))
+        assert set(loaded) == {"params", "batch_stats"}
+        want = jax.device_get(state.params)
+        got = loaded["params"]
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            want,
+            got,
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            jax.device_get(state.batch_stats),
+            loaded["batch_stats"],
+        )
+
+    def test_prefers_model_best_over_checkpoint(self, tmp_path):
+        from bdbnn_tpu.utils.checkpoint import load_variables
+
+        run, fresh_template = _setup()
+        s1, _ = run(fresh_template(), n=1)
+        save_checkpoint(
+            str(tmp_path), s1, epoch=0, arch="tiny", best_acc1=1.0,
+            is_best=True,  # model_best = s1
+        )
+        # fetch BEFORE stepping again: the jitted step donates the
+        # input state, deleting s1's buffers
+        best_leaf = np.asarray(_leaves(jax.device_get(s1.params))[0])
+        s2, _ = run(s1, n=1)
+        save_checkpoint(
+            str(tmp_path), s2, epoch=1, arch="tiny", best_acc1=1.0,
+            is_best=False,  # checkpoint = s2, model_best stays s1
+        )
+        loaded = load_variables(str(tmp_path))
+        got_leaf = np.asarray(_leaves(loaded["params"])[0])
+        np.testing.assert_array_equal(got_leaf, best_leaf)
+
+    def test_rejects_non_checkpoint(self, tmp_path):
+        from bdbnn_tpu.utils.checkpoint import load_variables
+
+        with pytest.raises(Exception):
+            load_variables(str(tmp_path / "nope"))

@@ -261,12 +261,15 @@ class TestTFDataImageFolderPipeline:
         )
         (xt, _), = list(tf_pipe.epoch(0))
         (xp, _), = list(pil_pipe.epoch(0))
-        # same geometry; bilinear kernels differ slightly between
-        # TF and PIL, so compare means and per-pixel tolerance
+        # same geometry; with antialias=True on the tf resizes
+        # (ADVICE r4 — PIL antialiases, tf by default does not) the two
+        # kernels agree to within a few of 255 levels (measured: mean
+        # |diff| ~1.1, max 5 on this fixture) — tight enough that a
+        # systematic resize-protocol deviation fails the suite
         assert xt.shape == xp.shape
         diff = np.abs(xt.astype(np.int32) - xp.astype(np.int32))
-        assert float(np.mean(diff)) < 10.0
-        assert float(np.mean(diff < 32)) > 0.95
+        assert float(np.mean(diff)) < 2.0
+        assert float(np.mean(diff < 8)) > 0.995
 
     def test_host_sharding_disjoint(self, jpeg_folder):
         from bdbnn_tpu.data import TFDataImageFolderPipeline
