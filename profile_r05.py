@@ -1,0 +1,160 @@
+"""On-chip profile capture → profiles/r05/PROFILE_r05.json (VERDICT r4
+next-round #2: show the convert/reduce breakdown shift from the fused
+single-pass kurtosis moments + native maxpool padding, target ≥50%
+device MFU or a written analysis of the residual).
+
+Reuses bench.py's compiled flagship step (BASELINE config 3 workload:
+binary ResNet-18 react @ 224², bf16, batch 128, fwd+bwd+Adam+19-layer
+kurtosis) and its fenced measurement; adds a per-op device-time
+breakdown aggregated from the jax.profiler trace, in the same category
+shape as profiles/r04/PROFILE_r04.json so the two are directly
+comparable.
+
+Run on the real chip (dies fast if the tunnel is down):
+    python profile_r05.py [--batch 128] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import datetime
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import sys
+
+import bench
+
+
+def _trace_breakdown(trace_path: str, n_steps: int):
+    """Aggregate device-track op durations (ms/step) by normalized HLO
+    op name (trailing .N / digit suffixes stripped), top groups +
+    'other'."""
+    with gzip.open(trace_path) as f:
+        tr = json.load(f)
+    events = tr.get("traceEvents", [])
+    pids = {
+        e["pid"]: e["args"].get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    device_pids = {
+        p for p, n in pids.items() if "TPU" in n or "device" in n.lower()
+    }
+    groups: dict = collections.defaultdict(float)
+    step_total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        name = str(e.get("name", ""))
+        dur_ms = e.get("dur", 0) / 1e3
+        if name.startswith("jit_train_step"):
+            step_total += dur_ms
+            continue
+        base = re.sub(r"[.\d]+$", "", name)
+        groups[base] += dur_ms
+    per_step = {
+        k: round(v / max(n_steps, 1), 3)
+        for k, v in sorted(groups.items(), key=lambda kv: -kv[1])
+    }
+    top = dict(list(per_step.items())[:10])
+    rest = sum(list(per_step.values())[10:])
+    if rest:
+        top["other"] = round(rest, 3)
+    return top, (step_total / max(n_steps, 1) if step_total else None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--out-dir", default="profiles/r05")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    dev = jax.devices()[0]
+    print(f"[profile] device: {dev.device_kind} ({dev.platform})",
+          file=sys.stderr)
+
+    compiled, state, batch_xy, tk, gate, flops = bench._compile_step(
+        "bfloat16", args.batch
+    )
+    host_rate, state = bench._measure_compiled(
+        compiled, state, batch_xy, tk, gate, args.batch, args.iters
+    )
+
+    trace_dir = os.path.join(args.out_dir, "trace")
+    dev_ms, trace_path, state = bench._profile_device_ms(
+        compiled, state, batch_xy, tk, gate, args.batch, trace_dir
+    )
+    breakdown, step_total_ms = (
+        _trace_breakdown(trace_path, 5) if trace_path else ({}, None)
+    )
+
+    peak = bench.BF16_PEAK_TFLOPS.get(dev.device_kind)
+    dev_rate = args.batch / (dev_ms / 1e3) if dev_ms else None
+    out = {
+        "what": (
+            "jax.profiler trace of 5 steps of the flagship bench "
+            "workload after the r5 perf changes (fused single-pass "
+            "kurtosis raw moments; native reduce_window maxpool "
+            "padding): full BD-BNN train step (fwd + bwd + Adam + "
+            "19-layer kurtosis), binary ResNet-18 react @ 224x224, "
+            f"bf16, batch {args.batch}, conv_impl=dot"
+        ),
+        "captured": datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%MZ"
+        )
+        + " on the attached chip",
+        "device_kind": dev.device_kind,
+        "bf16_peak_tflops": peak,
+        "trace_file": os.path.basename(trace_path) if trace_path else None,
+        "flops_per_step_xla_cost_analysis": flops,
+        "gflops_per_image": round(flops / args.batch / 1e9, 2) if flops else None,
+        "device_ms_per_step_median": round(dev_ms, 2) if dev_ms else None,
+        "device_images_per_sec": round(dev_rate) if dev_rate else None,
+        "device_mfu": (
+            round(flops / (dev_ms / 1e3) / (peak * 1e12), 3)
+            if dev_ms and flops and peak
+            else None
+        ),
+        "host_fenced_median_img_per_sec": round(host_rate),
+        "host_fenced_ms_per_step": round(args.batch / host_rate * 1e3, 2),
+        "host_fenced_mfu": (
+            round(flops * host_rate / args.batch / (peak * 1e12), 3)
+            if flops and peak
+            else None
+        ),
+        "device_time_breakdown_ms_per_step": breakdown,
+        "device_track_total_ms_per_step": (
+            round(step_total_ms, 2) if step_total_ms else None
+        ),
+        "r04_comparison": {
+            "source": "profiles/r04/PROFILE_r04.json",
+            "device_ms_per_step_median": 16.99,
+            "device_mfu": 0.383,
+            "convert_reduce_fusion_ms": 5.44,
+            "pad_plus_select_and_scatter_ms": 1.76,
+        },
+    }
+    os.makedirs(args.out_dir, exist_ok=True)
+    if trace_path:
+        shutil.copy(
+            trace_path, os.path.join(args.out_dir, "train_step_trace.json.gz")
+        )
+    out_path = os.path.join(args.out_dir, "PROFILE_r05.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    print(f"[profile] wrote {out_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Measure the --remat batch ceiling on the real chip (VERDICT r4
+next-round #3: the feature's justification — larger per-chip batches on
+memory-bound shapes — was asserted, never measured).
+
+For remat off/on, binary-search the largest flagship batch (binary
+ResNet-18 react @ 224², bf16, full train step incl. Adam + kurtosis)
+that compiles AND executes one step without an out-of-memory error.
+Writes profiles/r05/REMAT_CEILING_r05.json with the two ceilings and
+throughput at a common batch for the FLOPs-vs-HBM tradeoff.
+
+    python remat_ceiling.py [--max-batch 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+
+def _try_batch(batch: int, remat: bool) -> bool:
+    """One compiled+executed step at this batch; False on OOM."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bdbnn_tpu.models import conv_weight_paths, create_model
+    from bdbnn_tpu.train import (
+        StepConfig,
+        TrainState,
+        make_optimizer,
+        make_train_step,
+    )
+
+    try:
+        model = create_model(
+            "resnet18", "imagenet", dtype="bfloat16", remat=remat
+        )
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(batch, 224, 224, 3)),
+            jnp.float32,
+        )
+        y = jnp.asarray(
+            np.random.default_rng(1).integers(0, 1000, size=(batch,))
+        )
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)), train=True
+        )
+        paths = conv_weight_paths(variables["params"])
+        hooked = tuple(paths[1:])
+        cfg = StepConfig(
+            w_kurtosis=True,
+            kurt_paths=hooked,
+            kurt_targets=(1.8,) * len(hooked),
+            w_lambda_kurtosis=1.0,
+        )
+        tx = make_optimizer(
+            variables["params"], dataset="imagenet", lr=1e-3,
+            epochs=90, steps_per_epoch=1000,
+        )
+        state = TrainState.create(variables, tx)
+        step = jax.jit(make_train_step(model, tx, cfg), donate_argnums=(0,))
+        state, m = step(state, (x, y), (jnp.float32(1.0), jnp.float32(1.0)),
+                        jnp.float32(1.0))
+        loss = float(m["loss"])  # fence
+        ok = bool(jnp.isfinite(loss))
+        del state, m, step, x, y, variables
+        return ok
+    except Exception as e:  # XlaRuntimeError RESOURCE_EXHAUSTED etc.
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "OOM" in msg:
+            print(f"[remat] batch={batch} remat={remat}: OOM",
+                  file=sys.stderr)
+            return False
+        raise
+
+
+def _ceiling(lo_ok: int, hi_bad: int, remat: bool) -> int:
+    """Largest power-of-two-ish batch that fits: doubling then bisect."""
+    b = lo_ok
+    while b * 2 < hi_bad and _try_batch(b * 2, remat):
+        b *= 2
+    lo, hi = b, min(b * 2, hi_bad)  # lo fits, hi unknown/bad
+    while hi - lo > max(lo // 16, 8):
+        mid = (lo + hi) // 2
+        if _try_batch(mid, remat):
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-batch", type=int, default=8192)
+    ap.add_argument("--out-dir", default="profiles/r05")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    dev = jax.devices()[0]
+    assert _try_batch(64, False), "batch 64 must fit without remat"
+    no_remat = _ceiling(64, args.max_batch, remat=False)
+    assert _try_batch(64, True), "batch 64 must fit with remat"
+    with_remat = _ceiling(max(no_remat, 64), args.max_batch, remat=True)
+
+    out = {
+        "what": (
+            "--remat batch ceiling on the flagship workload (binary "
+            "ResNet-18 react @ 224x224 bf16, full train step): largest "
+            "batch that compiles + executes one step, with vs without "
+            "jax.checkpoint on the residual blocks"
+        ),
+        "captured": datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%MZ"
+        ),
+        "device_kind": dev.device_kind,
+        "max_batch_no_remat": no_remat,
+        "max_batch_with_remat": with_remat,
+        "ceiling_gain": round(with_remat / no_remat, 2),
+    }
+    os.makedirs(args.out_dir, exist_ok=True)
+    path = os.path.join(args.out_dir, "REMAT_CEILING_r05.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

