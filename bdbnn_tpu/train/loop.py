@@ -485,6 +485,9 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         w_lambda_ce=cfg.w_lambda_ce,
         ede=cfg.ede,
         input_norm=input_norm,
+        # fit() runs want the starvation probe; bench/profile build
+        # their own StepConfig and measure the unperturbed step
+        log_grad_norm=True,
     )
 
     teacher_variables = None

@@ -78,6 +78,11 @@ class StepConfig:
     kd_pairs: Tuple[Tuple[Tuple[str, ...], Tuple[str, ...]], ...] = ()
     # EDE
     ede: bool = False
+    # observability: emit optax.global_norm(grads) as metrics
+    # ['grad_norm'] — the estimator-starvation probe (VERDICT r4 weak
+    # #5). Default OFF so bench/profile workloads that build StepConfig
+    # directly measure the unperturbed step; fit() turns it on.
+    log_grad_norm: bool = False
     # device-side input normalization (TPU-first input path): when set
     # to per-channel ((mean,...), (std,...)) in 0-1 scale, the step
     # receives RAW uint8 NHWC batches and normalizes on device — the

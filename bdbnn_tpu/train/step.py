@@ -137,11 +137,15 @@ def make_train_step(
             # its example count, not average per-step means (which skews
             # when the final print interval is shorter — VERDICT r3 #6)
             "loss_sum": aux["loss"] * labels.shape[0],
-            # global gradient norm: one extra reduction, and the direct
+            # global gradient norm (cfg.log_grad_norm): the direct
             # probe for estimator starvation (EDE's backward
             # k·t·sech²(t·x) → 0 a.e. as t anneals to 10 — VERDICT r4
             # weak #5 asked for exactly this signal per epoch)
-            "grad_norm": optax.global_norm(grads),
+            **(
+                {"grad_norm": optax.global_norm(grads)}
+                if cfg.log_grad_norm
+                else {}
+            ),
             **topk_correct(logits, labels),
             "count": jnp.int32(labels.shape[0]),
         }
@@ -224,7 +228,11 @@ def make_ts_train_step(
         metrics = {
             **aux,
             "loss_sum": aux["loss"] * labels.shape[0],
-            "grad_norm": optax.global_norm(grads),
+            **(
+                {"grad_norm": optax.global_norm(grads)}
+                if cfg.log_grad_norm
+                else {}
+            ),
             **topk_correct(logits, labels),
             "count": jnp.int32(labels.shape[0]),
         }

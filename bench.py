@@ -63,6 +63,9 @@ BASELINE_IMAGES_PER_SEC_PER_CHIP = float(
 )
 METRIC = "train_step_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
+# steps traced by _profile_device_ms; consumers dividing aggregate
+# trace durations into per-step numbers (profile_r05.py) must use THIS
+PROFILE_TRACE_STEPS = 5
 
 # Published per-chip dense bf16 peaks (TFLOP/s). Keyed on
 # jax.devices()[0].device_kind. Sources: Google Cloud TPU system
@@ -192,7 +195,7 @@ def _profile_device_ms(compiled, state, batch_xy, tk, gate, batch: int,
     os.makedirs(profile_dir, exist_ok=True)
     with jax.profiler.trace(profile_dir):
         s, m = state, None
-        for _ in range(5):
+        for _ in range(PROFILE_TRACE_STEPS):
             s, m = compiled(s, batch_xy, tk, gate)
         _ = float(m["loss"])
 

@@ -95,7 +95,9 @@ def main():
         compiled, state, batch_xy, tk, gate, args.batch, trace_dir
     )
     breakdown, step_total_ms = (
-        _trace_breakdown(trace_path, 5) if trace_path else ({}, None)
+        _trace_breakdown(trace_path, bench.PROFILE_TRACE_STEPS)
+        if trace_path
+        else ({}, None)
     )
 
     peak = bench.BF16_PEAK_TFLOPS.get(dev.device_kind)

@@ -4,9 +4,11 @@ memory-bound shapes — was asserted, never measured).
 
 For remat off/on, binary-search the largest flagship batch (binary
 ResNet-18 react @ 224², bf16, full train step incl. Adam + kurtosis)
-that compiles AND executes one step without an out-of-memory error.
-Writes profiles/r05/REMAT_CEILING_r05.json with the two ceilings and
-throughput at a common batch for the FLOPs-vs-HBM tradeoff.
+that compiles AND executes one step without an out-of-memory error,
+then measures fenced throughput at a common batch — the two halves of
+the FLOPs-vs-HBM tradeoff (how much batch headroom remat buys, and
+what its ~1/3 recompute overhead costs). Writes
+profiles/r05/REMAT_CEILING_r05.json.
 
     python remat_ceiling.py [--max-batch 4096]
 """
@@ -20,8 +22,9 @@ import os
 import sys
 
 
-def _try_batch(batch: int, remat: bool) -> bool:
-    """One compiled+executed step at this batch; False on OOM."""
+def _try_batch(batch: int, remat: bool, time_iters: int = 0):
+    """One compiled+executed step at this batch; False on OOM. With
+    ``time_iters``, returns fenced images/sec instead of True."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -39,8 +42,9 @@ def _try_batch(batch: int, remat: bool) -> bool:
             "resnet18", "imagenet", dtype="bfloat16", remat=remat
         )
         x = jnp.asarray(
-            np.random.default_rng(0).normal(size=(batch, 224, 224, 3)),
-            jnp.float32,
+            np.random.default_rng(0).standard_normal(
+                size=(batch, 224, 224, 3), dtype=np.float32
+            )
         )
         y = jnp.asarray(
             np.random.default_rng(1).integers(0, 1000, size=(batch,))
@@ -62,10 +66,22 @@ def _try_batch(batch: int, remat: bool) -> bool:
         )
         state = TrainState.create(variables, tx)
         step = jax.jit(make_train_step(model, tx, cfg), donate_argnums=(0,))
-        state, m = step(state, (x, y), (jnp.float32(1.0), jnp.float32(1.0)),
-                        jnp.float32(1.0))
+        tk = (jnp.float32(1.0), jnp.float32(1.0))
+        state, m = step(state, (x, y), tk, jnp.float32(1.0))
         loss = float(m["loss"])  # fence
         ok = bool(jnp.isfinite(loss))
+        if ok and time_iters:
+            import time
+
+            state, m = step(state, (x, y), tk, jnp.float32(1.0))
+            float(m["loss"])  # warm + fence
+            t0 = time.perf_counter()
+            for _ in range(time_iters):
+                state, m = step(state, (x, y), tk, jnp.float32(1.0))
+            float(m["loss"])  # fence
+            rate = time_iters * batch / (time.perf_counter() - t0)
+            del state, m, step, x, y, variables
+            return rate
         del state, m, step, x, y, variables
         return ok
     except Exception as e:  # XlaRuntimeError RESOURCE_EXHAUSTED etc.
@@ -104,9 +120,27 @@ def main():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     dev = jax.devices()[0]
     assert _try_batch(64, False), "batch 64 must fit without remat"
-    no_remat = _ceiling(64, args.max_batch, remat=False)
+    # a ceiling equal to --max-batch means the search was CAPPED, not
+    # that the memory limit was found (code-review r5)
+    if _try_batch(args.max_batch, False):
+        no_remat, no_remat_capped = args.max_batch, True
+    else:
+        no_remat, no_remat_capped = _ceiling(
+            64, args.max_batch, remat=False
+        ), False
     assert _try_batch(64, True), "batch 64 must fit with remat"
-    with_remat = _ceiling(max(no_remat, 64), args.max_batch, remat=True)
+    if _try_batch(args.max_batch, True):
+        with_remat, with_remat_capped = args.max_batch, True
+    else:
+        with_remat, with_remat_capped = _ceiling(
+            max(no_remat, 64), args.max_batch, remat=True
+        ), False
+
+    # recompute-cost half of the tradeoff: fenced throughput at a
+    # common batch that fits both configurations
+    common = min(no_remat, with_remat, 256)
+    rate_no = _try_batch(common, False, time_iters=10)
+    rate_with = _try_batch(common, True, time_iters=10)
 
     out = {
         "what": (
@@ -120,8 +154,18 @@ def main():
         ),
         "device_kind": dev.device_kind,
         "max_batch_no_remat": no_remat,
+        "max_batch_no_remat_capped_by_search_limit": no_remat_capped,
         "max_batch_with_remat": with_remat,
+        "max_batch_with_remat_capped_by_search_limit": with_remat_capped,
         "ceiling_gain": round(with_remat / no_remat, 2),
+        "throughput_common_batch": common,
+        "img_per_sec_no_remat": round(rate_no) if rate_no else None,
+        "img_per_sec_with_remat": round(rate_with) if rate_with else None,
+        "remat_throughput_cost": (
+            round(1.0 - rate_with / rate_no, 3)
+            if rate_no and rate_with
+            else None
+        ),
     }
     os.makedirs(args.out_dir, exist_ok=True)
     path = os.path.join(args.out_dir, "REMAT_CEILING_r05.json")
