@@ -1,0 +1,45 @@
+"""Artifact-runner helpers (run_kd): stale-run isolation — a rerun in
+the same workdir must read ONLY the latest timestamped run (the round-5
+code review caught curves merging across a crashed run and its rerun)."""
+
+import json
+import os
+
+import pytest
+
+import run_kd
+
+
+@pytest.mark.fast
+class TestLatestRunSelection:
+    def _mk_run(self, root, stamp, tag_value, with_best=True):
+        d = root / "1.8" / stamp
+        d.mkdir(parents=True)
+        with open(d / "scalars.jsonl", "w") as f:
+            f.write(json.dumps(
+                {"tag": "Val Acc1", "value": tag_value, "step": 0}
+            ) + "\n")
+        if with_best:
+            (d / "model_best").mkdir()
+        return d
+
+    def test_read_curves_uses_latest_only(self, tmp_path):
+        self._mk_run(tmp_path, "2026-07-30_10-00-00", 11.0)
+        self._mk_run(tmp_path, "2026-07-30_12-00-00", 99.0)
+        curves = run_kd._read_curves(str(tmp_path), ("Val Acc1",))
+        assert curves["Val Acc1"] == [99.0]
+
+    def test_read_curves_empty_workdir(self, tmp_path):
+        assert run_kd._read_curves(str(tmp_path), ("Val Acc1",)) == {}
+
+    def test_find_run_dir_prefers_latest(self, tmp_path):
+        old = self._mk_run(tmp_path, "2026-07-30_10-00-00", 1.0)
+        new = self._mk_run(tmp_path, "2026-07-30_12-00-00", 2.0)
+        assert run_kd._find_run_dir(str(tmp_path)) == str(new)
+        assert run_kd._find_run_dir(str(tmp_path)) != str(old)
+
+    def test_find_run_dir_raises_without_checkpoint(self, tmp_path):
+        # runs exist but none ever checkpointed
+        self._mk_run(tmp_path, "2026-07-30_10-00-00", 1.0, with_best=False)
+        with pytest.raises(FileNotFoundError):
+            run_kd._find_run_dir(str(tmp_path))
