@@ -1,0 +1,116 @@
+"""Fold the KD hyperparameter-search negative evidence into
+ACCURACY_r05_ts.json.
+
+Three configurations preceded the shipped run, each pinned at chance (~10% val
+top-1 on 10 classes) and each preserved in
+``evidence/r05/kd_negative/`` — they are the measured basis for
+the shipped recipe's two deviations from the reference defaults
+(student lr, β) and for the quantitative diagnosis of WHY β=200 is
+poisonous at resnet20 widths. Run after run_kd.py completes:
+
+    python finalize_kd_artifact.py [--artifact ACCURACY_r05_ts.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import json
+import os
+
+EVIDENCE_DIR = "evidence/r05/kd_negative"
+
+RUNS = {
+    "2026-07-30_17-23-10": {
+        "config": "reference defaults: beta=200, Adam lr 0.1 "
+                  "(the no-KD headline's lr)",
+    },
+    "2026-07-30_18-00-19": {
+        "config": "beta=200, Adam lr 0.001 (the reference ImageNet "
+                  "policy's lr scale)",
+    },
+    "2026-07-30_18-14-00": {
+        "config": "beta=1, Adam lr 0.001",
+    },
+}
+
+DIAGNOSIS = (
+    "The reference's layer KL is torch KLDivLoss(log_target=True) on "
+    "RAW weights with elementwise-mean reduction (ref utils/KD_loss.py"
+    ":56-65): d/dw_s of beta*mean(exp(w_t)*(w_t - w_s)) = "
+    "-beta*exp(w_t)/N_elements per element — a CONSTANT drift term "
+    "independent of the student's weights. Its magnitude scales as "
+    "beta/N. At ImageNet ResNet-18 widths (N ~ 2.4M for a 3x3x512x512 "
+    "kernel) beta=200 gives ~1e-4 per element — benign next to CE "
+    "gradients. At resnet20-CIFAR widths (N ~ 2.3k for 3x3x16x16) the "
+    "same beta gives ~0.09 — it dominates the loss, Adam normalizes "
+    "it to a full lr-sized step every update, and the latent weights "
+    "inflate monotonically (loss_kl ran to -87,159 in 27 epochs at "
+    "lr 0.1) while accuracy stays at chance. Rescaling beta to ~1 "
+    "restores balance on the narrow net (run 3 trend + the shipped "
+    "run); lr must stay at the adaptive-policy 0.1 the no-KD ablation "
+    "measured for binary latents on this dataset (run 3 at lr 0.001 "
+    "plateaued at chance for 10 epochs). The beta/N sensitivity is a "
+    "property of the reference's shipped loss (replicated deliberately "
+    "here), surfaced because BASELINE config 2 pairs it with a CIFAR "
+    "net narrower than the loss's ImageNet tuning."
+)
+
+
+def _curves(path):
+    rows = [json.loads(l) for l in open(path)]
+
+    def tag(t):
+        return [round(r["value"], 3) for r in sorted(
+            (r for r in rows if r["tag"] == t), key=lambda r: r["step"]
+        )]
+
+    return {
+        "val_top1_curve": tag("Val Acc1"),
+        "train_loss_kl_curve": tag("Train loss_kl"),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--artifact", default="ACCURACY_r05_ts.json")
+    args = ap.parse_args()
+
+    with open(args.artifact) as f:
+        art = json.load(f)
+
+    negative = []
+    for stamp, meta in RUNS.items():
+        path = os.path.join(EVIDENCE_DIR, f"{stamp}_scalars.jsonl")
+        if not os.path.exists(path):
+            continue
+        c = _curves(path)
+        negative.append({
+            "config": meta["config"],
+            "epochs_run": len(c["val_top1_curve"]),
+            "val_top1_curve": c["val_top1_curve"],
+            "train_loss_kl_first_last": (
+                [c["train_loss_kl_curve"][0], c["train_loss_kl_curve"][-1]]
+                if c["train_loss_kl_curve"]
+                else None
+            ),
+            "outcome": "pinned at chance (~10% top-1), run stopped",
+            "scalars": path,
+        })
+
+    art["hyperparameter_search_negative_results"] = negative
+    art["beta_rescale_diagnosis"] = DIAGNOSIS
+    art["shipped_deviations_from_reference_defaults"] = {
+        "beta": "1.0 (reference default 200, ref train.py:170) — see "
+                "beta_rescale_diagnosis",
+        "lr": "0.1 under adam-linear (matches the no-KD headline run "
+              "ACCURACY_r04.json, so the KD-vs-no-KD comparison is "
+              "at equal lr AND equal epochs)",
+    }
+    with open(args.artifact, "w") as f:
+        json.dump(art, f, indent=2)
+    print(f"updated {args.artifact}: {len(negative)} negative runs folded in")
+
+
+if __name__ == "__main__":
+    main()
