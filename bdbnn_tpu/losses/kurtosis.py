@@ -51,33 +51,31 @@ DIFFKURT_TARGETS_TS: tuple = (
 def kurtosis(w: Array) -> Array:
     """kurt(W) = mean(((W - mean) / std)^4) with Bessel-corrected std.
 
-    Computed from RAW MOMENTS in a single fused pass: Σw, Σw², Σw³,
-    Σw⁴ read the tensor once (XLA fuses the four sums into one loop),
+    Two-pass CENTERED moments: pass 1 is the mean (one read), pass 2
+    computes Σd² and Σd⁴ of d = w − μ in one fused loop (second read),
     then
 
-        μ   = Σw/n
-        s²  = (Σw² − nμ²)/(n−1)            (Bessel, ddof=1)
-        m₄  = (Σw⁴ − 4μΣw³ + 6μ²Σw² − 3nμ⁴)/n
-        kurt = m₄ / s⁴
+        s²  = Σd²/(n−1)                    (Bessel, ddof=1)
+        kurt = (Σd⁴/n) / s⁴
 
-    — algebraically identical to the naive mean/std/z⁴ chain, which
-    cost 3–4 reads of the tensor and dominated device step time (32%
-    "convert_reduce_fusion", profiles/r04/PROFILE_r04.json; VERDICT r4
-    next-round #2). Latent weights are He-initialized with μ ≈ 0, so
-    raw-moment cancellation is benign in f32 (oracle-tested against
-    torch in tests/test_kurtosis.py).
+    The naive mean/std/z⁴ chain cost 3–4 reads of each latent tensor
+    and dominated device step time (32% "convert_reduce_fusion",
+    profiles/r04/PROFILE_r04.json; VERDICT r4 next-round #2). A pure
+    single-pass raw-moment form (Σw..Σw⁴) would be one read cheaper
+    still, but catastrophically cancels once |μ|/σ ≳ 40 in f32
+    (measured: kurt −131 vs true 3.05 at μ=−8, σ=0.05) — the centered
+    form is exact for any offset and keeps the fused-single-reduction
+    structure where it matters (tests/test_kurtosis.py pins both the
+    torch oracle and the offset robustness).
     """
     w = w.reshape(-1).astype(jnp.float32)
     n = w.size
-    w2 = w * w
-    s1 = jnp.sum(w)
-    s2 = jnp.sum(w2)
-    s3 = jnp.sum(w2 * w)
-    s4 = jnp.sum(w2 * w2)
-    mu = s1 / n
-    var = (s2 - n * mu * mu) / (n - 1)
-    m4 = (s4 - 4.0 * mu * s3 + 6.0 * mu * mu * s2 - 3.0 * n * mu**4) / n
-    return m4 / (var * var)
+    d = w - jnp.mean(w)
+    d2 = d * d
+    s2 = jnp.sum(d2)
+    s4 = jnp.sum(d2 * d2)
+    var = s2 / (n - 1)
+    return (s4 / n) / (var * var)
 
 
 def kurtosis_loss(w: Array, target) -> Array:

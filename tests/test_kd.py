@@ -112,3 +112,24 @@ def test_match_conv_pairs_skips_stem_and_downsample():
     assert "stem/weight" not in names
     assert not any("downsample" in n for n in names)
     assert len(pairs) == 3
+
+
+def test_layer_weight_kl_student_gradient_is_constant_drift(rng):
+    """The property behind the measured beta/N failure mode
+    (ACCURACY_r05_ts.json): d/dw_s of mean(exp(w_t)*(w_t - w_s)) is
+    EXACTLY -exp(w_t)/N per element — independent of the student's
+    weights. Any beta whose drift rivals the per-weight gradient noise
+    floor therefore compounds under Adam instead of averaging out."""
+    import jax
+
+    wt = jnp.asarray(rng.normal(size=(3, 3, 4, 8)).astype(np.float32))
+    for seed in (0, 1):
+        ws = jnp.asarray(
+            np.random.default_rng(seed).normal(size=wt.shape).astype(np.float32)
+        )
+        g = jax.grad(lambda w: layer_weight_kl([w], [wt]))(ws)
+        np.testing.assert_allclose(
+            np.asarray(g), -np.exp(np.asarray(wt)) / wt.size,
+            rtol=1e-5,
+        )
+    # same-student gradient regardless of ws: constant drift

@@ -108,3 +108,22 @@ def test_resolve_targets():
     )
     with pytest.raises(ValueError):
         resolve_targets(7, diffkurt=True)
+
+
+def test_kurtosis_robust_to_mean_offset(rng):
+    """Offset-robustness pin: blocks regressing kurtosis() to the
+    rejected single-pass raw-moment form, which catastrophically
+    cancels in f32 once |mean|/std >~ 40 (measured kurt -131 vs true
+    3.05 at mean -8, std 0.05). The shipped two-pass centered form
+    must stay exact for any offset."""
+    for offset in (0.0, 0.5, -2.0):
+        w = (rng.normal(size=(3, 3, 32, 32)) * 0.05 + offset).astype(
+            np.float32
+        )
+        wt = torch.tensor(w.reshape(-1), dtype=torch.float64)
+        z = (wt - wt.mean()) / wt.std()  # torch std = Bessel ddof=1
+        want = float((z**4).mean())
+        got = float(kurtosis(jnp.asarray(w)))
+        assert abs(got - want) < 1e-3 * max(1.0, abs(want)), (
+            offset, got, want
+        )
