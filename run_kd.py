@@ -82,6 +82,12 @@ def main():
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--lr", type=float, default=0.1,
                     help="student lr (the no-KD headline's)")
+    ap.add_argument("--arch", default="resnet20",
+                    help="binary student arch (resnet20_react + --react "
+                    "= the config-4-shaped recipe)")
+    ap.add_argument("--react", action="store_true",
+                    help="reference react mode: beta=0, CE=0 — pure "
+                    "logit distillation (ref train.py:605-609)")
     ap.add_argument("--alpha", type=float, default=0.9)
     ap.add_argument("--beta", type=float, default=200.0)
     ap.add_argument("--temperature", type=float, default=4.0)
@@ -152,7 +158,7 @@ def main():
     cfg_s = RunConfig(
         data=data_dir,
         dataset="cifar10",
-        arch="resnet20",
+        arch=args.arch,
         epochs=args.epochs,
         batch_size=args.batch,
         lr=args.lr,
@@ -161,6 +167,7 @@ def main():
         w_kurtosis_target=1.8,
         w_lambda_kurtosis=1.0,
         imagenet_setting_step_2_ts=True,
+        react=args.react,
         arch_teacher="resnet20_float",
         resume_teacher=teacher_meta["ckpt_dir"],
         alpha=args.alpha,
@@ -215,7 +222,8 @@ def main():
         **counts,
         "teacher": teacher_meta,
         "student": {
-            "arch": "resnet20 (binary)",
+            "arch": f"{args.arch} (binary)",
+            "react": args.react,
             "epochs": args.epochs,
             "lr": args.lr,
             "opt_policy": "adam-linear",
