@@ -1,0 +1,21 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os; os.environ["JAX_PLATFORMS"]="cpu"
+import jax; jax.config.update("jax_platforms","cpu")
+import tempfile, json, glob, numpy as np
+from run_accuracy import make_digits_npz
+from bdbnn_tpu.configs.config import RunConfig
+from bdbnn_tpu.train.loop import fit
+with tempfile.TemporaryDirectory() as tmp:
+    make_digits_npz(tmp)
+    cfg = RunConfig(data=tmp, dataset="cifar10", arch="resnet18", epochs=3,
+                    batch_size=128, lr=0.1, opt_policy="adam-linear",
+                    w_kurtosis=True, diffkurt=True, kurtepoch=1,
+                    seed=0, print_freq=5, log_path=os.path.join(tmp,"log"))
+    res = fit(cfg)
+    scal=[json.loads(l) for p in glob.glob(os.path.join(tmp,"log","**","scalars.jsonl"),recursive=True) for l in open(p)]
+    kurt=[s["value"] for s in scal if s["tag"]=="Train loss_kurt"]
+    print("diffkurt e2e:", res, "kurt per epoch:", [round(k,4) for k in kurt])
+    assert all(np.isfinite(k) for k in kurt)
+    assert kurt[0] == 0.0  # kurtepoch=1 gates epoch 0 off
+    assert kurt[1] > 0.0
+    print("DIFFKURT+KURTEPOCH E2E OK")
