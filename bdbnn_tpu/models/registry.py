@@ -59,7 +59,7 @@ def _make_cifar(name, stage_sizes, width, variant, act, num_classes,
 
 def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000,
                    pretrained=False, dtype=None, twoblock=False,
-                   remat=False):
+                   remat=False, block="basic"):
     # ``pretrained`` accepted for reference-API parity (train.py:285-288);
     # the actual weight loading goes through create_model's caller via
     # bdbnn_tpu.models.torch_import (no network egress in this image).
@@ -74,6 +74,7 @@ def _make_imagenet(name, stage_sizes, variant, act, num_classes=1000,
         dtype=resolve_dtype(dtype),
         twoblock=twoblock,
         remat=remat,
+        block=block,
     )
 
 
@@ -125,6 +126,11 @@ def imagenet_model_factories(num_classes: int = 1000) -> Dict[str, Callable]:
         # FP teachers (↔ torchvision resnet18/34)
         "resnet18_float": f(_make_imagenet, "resnet18_float", (2, 2, 2, 2), "float", "identity", num_classes),
         "resnet34_float": f(_make_imagenet, "resnet34_float", (3, 4, 6, 3), "float", "identity", num_classes),
+        # bottleneck FP teachers (↔ torchvision resnet50/101, the common
+        # ImageNet KD teachers; reference names any torchvision ctor,
+        # train.py:44-48) — float/teacher path only, see FloatBottleneck
+        "resnet50_float": f(_make_imagenet, "resnet50_float", (3, 4, 6, 3), "float", "identity", num_classes, block="bottleneck"),
+        "resnet101_float": f(_make_imagenet, "resnet101_float", (3, 4, 23, 3), "float", "identity", num_classes, block="bottleneck"),
     }
 
 

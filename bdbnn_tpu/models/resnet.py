@@ -203,6 +203,74 @@ class BiBasicBlock(nn.Module):
         return nn.relu(y + identity)
 
 
+class FloatBottleneck(nn.Module):
+    """Torch-faithful torchvision Bottleneck block for FP teachers.
+
+    The reference's teacher builder accepts ANY torchvision constructor
+    name (``train.py:44-48, 253-258``), which includes the
+    bottleneck-family resnets (resnet50/101/152) — the most common
+    ImageNet KD teachers. This closes that registry-surface gap for the
+    float/teacher path (VERDICT r4 "Missing #4"); the *binary* lineage
+    stays basic-block only, matching the paper + the 19-conv flagship
+    constraint (reference ``train.py:467-475``).
+
+    Forward (torchvision resnet.py Bottleneck, expansion 4):
+    ``relu(bn1(conv1_1x1(x)))`` → ``relu(bn2(conv2_3x3_stride(·)))`` →
+    ``bn3(conv3_1x1_4w(·))`` → add identity (strided-1x1 downsample when
+    shapes change) → relu. Module names keep the torch-import key
+    translation working unchanged (``conv3``/``bn3`` translate
+    generically; ``downsample.0/.1`` → ``downsample_conv``/
+    ``downsample_bn``).
+    """
+
+    features: int  # base width; block output is 4x this
+    strides: int = 1
+    dtype: Any = None
+
+    EXPANSION = 4
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = True, tk=None) -> Array:
+        # same positional-binding guard as BiBasicBlock (remat
+        # static_argnums marks train by position)
+        if not isinstance(train, bool):
+            raise TypeError(
+                f"train must be a bool, got {type(train).__name__} — "
+                "did you pass tk positionally as the second argument?"
+            )
+        del tk  # float teachers have no binarizer schedule
+        out_features = self.features * self.EXPANSION
+        identity = x
+        y = FloatConv(
+            self.features, kernel_size=(1, 1), strides=(1, 1), name="conv1"
+        )(x)
+        y = _batch_norm(train, "bn1", self.dtype)(y)
+        y = nn.relu(y)
+        y = FloatConv(
+            self.features,
+            kernel_size=(3, 3),
+            strides=(self.strides, self.strides),
+            name="conv2",
+        )(y)
+        y = _batch_norm(train, "bn2", self.dtype)(y)
+        y = nn.relu(y)
+        y = FloatConv(
+            out_features, kernel_size=(1, 1), strides=(1, 1), name="conv3"
+        )(y)
+        y = _batch_norm(train, "bn3", self.dtype)(y)
+        if self.strides != 1 or x.shape[-1] != out_features:
+            identity = FloatConv(
+                out_features,
+                kernel_size=(1, 1),
+                strides=(self.strides, self.strides),
+                name="downsample_conv",
+            )(x)
+            identity = _batch_norm(
+                train, "downsample_bn", self.dtype
+            )(identity)
+        return nn.relu(y + identity)
+
+
 class BiResNet(nn.Module):
     """Generic basic-block ResNet over binary or float conv variants.
 
@@ -234,6 +302,11 @@ class BiResNet(nn.Module):
     # memory-bound shapes (224x224 stem activations dominate).
     # Numerically identity; see tests/test_models.py::TestRemat.
     remat: bool = False
+    # 'basic' | 'bottleneck'. Bottleneck is float-teacher only (the
+    # torchvision resnet50/101/152 family the reference can name as a
+    # teacher, train.py:44-48); the binary lineage is basic-block by
+    # construction (19-conv flagship constraint).
+    block: str = "basic"
 
     _TWOBLOCK_PARTNER = {"react": "step2", "step2": "react", "cifar": "react"}
     _VARIANT_ACT = {"react": "rprelu", "step2": "hardtanh", "cifar": "hardtanh"}
@@ -267,29 +340,41 @@ class BiResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem: {self.stem!r}")
 
+        if self.block not in ("basic", "bottleneck"):
+            raise ValueError(f"unknown block: {self.block!r}")
+        if self.block == "bottleneck" and self.variant != "float":
+            raise ValueError(
+                "bottleneck blocks are float-teacher only; the binary "
+                "families are basic-block by construction"
+            )
         # static_argnums=(2,): `train` (0=module, 1=x) selects python
         # branches (BN mode) and must stay static under jax.checkpoint
+        base_cls = (
+            FloatBottleneck if self.block == "bottleneck" else BiBasicBlock
+        )
         block_cls = (
-            nn.remat(BiBasicBlock, static_argnums=(2,))
-            if self.remat
-            else BiBasicBlock
+            nn.remat(base_cls, static_argnums=(2,)) if self.remat else base_cls
         )
         block_idx = 0
         for s, num_blocks in enumerate(self.stage_sizes):
             features = self.width * (2**s)
             for b in range(num_blocks):
                 strides = 2 if (s > 0 and b == 0) else 1
-                variant, act = self.variant, self.act
-                if self.twoblock and variant != "float" and block_idx % 2 == 1:
-                    variant = self._TWOBLOCK_PARTNER[variant]
-                    act = self._VARIANT_ACT[variant]
+                if self.block == "bottleneck":
+                    variant_kwargs = {}
+                else:
+                    variant, act = self.variant, self.act
+                    if (self.twoblock and variant != "float"
+                            and block_idx % 2 == 1):
+                        variant = self._TWOBLOCK_PARTNER[variant]
+                        act = self._VARIANT_ACT[variant]
+                    variant_kwargs = {"variant": variant, "act": act}
                 x = block_cls(
                     features=features,
                     strides=strides,
-                    variant=variant,
-                    act=act,
                     dtype=self.dtype,
                     name=f"layer{s + 1}_{b}",
+                    **variant_kwargs,
                 )(x, train, tk)
                 block_idx += 1
 

@@ -47,6 +47,7 @@ from bdbnn_tpu.losses.kurtosis import resolve_targets
 from bdbnn_tpu.models import (
     conv_weight_paths,
     create_model,
+    get_by_path,
     module_path_str,
 )
 from bdbnn_tpu.models.torch_import import load_torch_checkpoint
@@ -502,12 +503,33 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             list(t_by_name),
         )
         s_by_name = {module_path_str(p): p for p in s_paths}
-        step_cfg = dataclasses.replace(
-            step_cfg,
-            kd_pairs=tuple(
-                (s_by_name[a], t_by_name[b]) for a, b in pair_names
-            ),
-        )
+        # Name-equal pairs can collide across block families (a
+        # bottleneck teacher reuses layerS_B.conv1/conv2 names with
+        # different kernel shapes than a basic-block student). The
+        # layer KL is elementwise over weight tensors, so shape-equal
+        # is a hard requirement — validate here, at init, not at jit
+        # trace time.
+        kd_pairs, mismatched = [], []
+        for a, b in pair_names:
+            sp, tp = s_by_name[a], t_by_name[b]
+            ss = get_by_path(variables["params"], sp).shape
+            ts = get_by_path(teacher_variables["params"], tp).shape
+            if ss == ts:
+                kd_pairs.append((sp, tp))
+            else:
+                mismatched.append((a, ss, ts))
+        if mismatched and step_cfg.resolved().beta != 0.0:
+            a, ss, ts = mismatched[0]
+            raise ValueError(
+                f"layer-KL (beta={step_cfg.beta}) needs shape-matched "
+                f"student/teacher conv pairs, but {len(mismatched)} "
+                f"name-matched pairs differ in shape (first: {a!r} "
+                f"student {ss} vs teacher {ts}). Cross-architecture "
+                "teachers (e.g. resnet50_float over a basic-block "
+                "student) support logit-only KD: use --react or "
+                "--beta 0."
+            )
+        step_cfg = dataclasses.replace(step_cfg, kd_pairs=tuple(kd_pairs))
         # teacher variables are a traced ARGUMENT, not a closure: baked
         # constants would bloat the executable + HBM and recompile on
         # teacher swap (round-1 weakness #10)

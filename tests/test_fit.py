@@ -71,6 +71,38 @@ class TestFitSmoke:
         )
         assert np.isfinite(res["best_acc1"])
 
+    def test_ts_mismatched_teacher_rejected_for_layer_kl(self, tmp_path):
+        """Name-matched conv pairs with different shapes (cross-width or
+        cross-block-family teachers, e.g. resnet18_float over a resnet20
+        student, or the bottleneck resnet50_float teachers) must fail
+        LOUDLY at init when the layer KL is active — not crash at trace
+        time or silently broadcast a wrong loss."""
+        with pytest.raises(ValueError, match="--react"):
+            fit(
+                _cfg(
+                    tmp_path,
+                    imagenet_setting_step_2_ts=True,
+                    arch_teacher="resnet18_float",
+                    allow_random_teacher=True,
+                    react=False,
+                    beta=1.0,
+                )
+            )
+
+    def test_ts_mismatched_teacher_ok_for_logit_only_kd(self, tmp_path):
+        """The same cross-architecture teacher is fine under --react
+        (beta resolves to 0; logit-only KD has no per-layer pairing)."""
+        res = fit(
+            _cfg(
+                tmp_path,
+                imagenet_setting_step_2_ts=True,
+                arch_teacher="resnet18_float",
+                allow_random_teacher=True,
+                react=True,
+            )
+        )
+        assert np.isfinite(res["best_acc1"])
+
     def test_evaluate_only_mode(self, tmp_path):
         """-e/--evaluate (reference train.py:376-379): restore a
         checkpoint, run ONE validation pass, return {'acc1'} without

@@ -360,6 +360,34 @@ class TestRegistrySurface:
         for name in list_models("imagenet"):
             assert name in msg
 
+    def test_bottleneck_teachers_match_torchvision_param_counts(self):
+        """resnet50_float / resnet101_float are exact structural twins
+        of torchvision resnet50/101 (param-for-param), so their
+        checkpoints ingest strictly."""
+        expected = {"resnet50_float": 25_557_032,
+                    "resnet101_float": 44_549_160}
+        for arch, want in expected.items():
+            m = create_model(arch, "imagenet")
+            v = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+            )
+            n = sum(
+                x.size for x in jax.tree_util.tree_leaves(v["params"])
+            )
+            assert n == want, (arch, n, want)
+
+    def test_bottleneck_is_float_only(self):
+        from bdbnn_tpu.models.resnet import BiResNet
+
+        model = BiResNet(
+            stage_sizes=(1, 1), num_classes=4, width=8, stem="cifar",
+            variant="react", act="rprelu", block="bottleneck",
+        )
+        with pytest.raises(ValueError, match="float-teacher only"):
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=False
+            )
+
     def test_every_baseline_config_arch_resolves(self):
         """BASELINE.json's five acceptance configs name these archs."""
         from bdbnn_tpu.models.registry import create_model

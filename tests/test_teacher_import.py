@@ -48,6 +48,58 @@ class TorchBasicBlock(tnn.Module):
         return self.relu(out + identity)
 
 
+class TorchBottleneck(tnn.Module):
+    """torchvision.models.resnet.Bottleneck, verbatim semantics
+    (expansion 4)."""
+
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, planes, 1, 1, 0, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.conv3 = tnn.Conv2d(planes, 4 * planes, 1, 1, 0, bias=False)
+        self.bn3 = tnn.BatchNorm2d(4 * planes)
+        self.relu = tnn.ReLU(inplace=True)
+        self.downsample = None
+        if stride != 1 or cin != 4 * planes:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, 4 * planes, 1, stride, bias=False),
+                tnn.BatchNorm2d(4 * planes),
+            )
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class TorchMiniBottleneckNet(tnn.Module):
+    """CIFAR-stem bottleneck ResNet matching BiResNet(stage_sizes=(1, 1),
+    width=8, stem='cifar', variant='float', block='bottleneck') with
+    torchvision parameter naming."""
+
+    def __init__(self, width=8, num_classes=4):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, width, 3, 1, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.relu = tnn.ReLU(inplace=True)
+        self.layer1 = tnn.Sequential(TorchBottleneck(width, width, 1))
+        self.layer2 = tnn.Sequential(TorchBottleneck(4 * width, 2 * width, 2))
+        self.fc = tnn.Linear(8 * width, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
 class TorchMiniResNet(tnn.Module):
     """CIFAR-stem BasicBlock ResNet matching
     BiResNet(stage_sizes=(1, 1), width=8, stem='cifar', variant='float')
@@ -115,6 +167,49 @@ class TestFloatTeacherParity:
         }
 
         x = np.random.default_rng(1).normal(size=(4, 16, 16, 3)).astype(
+            np.float32
+        )
+        with torch.no_grad():
+            ref = net(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
+        out = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+    def test_bottleneck_forward_matches_torch_oracle(self):
+        """Bottleneck-family teachers (torchvision resnet50/101; the
+        reference names any torchvision ctor, train.py:44-48) ingest and
+        compute the same logits."""
+        torch.manual_seed(7)
+        net = TorchMiniBottleneckNet()
+        with torch.no_grad():
+            for m in net.modules():
+                if isinstance(m, tnn.BatchNorm2d):
+                    m.weight.uniform_(0.5, 1.5)
+                    m.bias.uniform_(-0.3, 0.3)
+                    m.running_mean.uniform_(-0.2, 0.2)
+                    m.running_var.uniform_(0.5, 1.5)
+        net.eval()
+        converted = convert_torch_state_dict(dict(net.state_dict()))
+
+        model = BiResNet(
+            stage_sizes=(1, 1), num_classes=4, width=8,
+            stem="cifar", variant="float", act="identity",
+            block="bottleneck",
+        )
+        template = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False
+        )
+        variables = {
+            "params": _overlay(
+                template["params"], converted["params"],
+                scope="t", allow_missing=False,
+            ),
+            "batch_stats": _overlay(
+                template["batch_stats"], converted["batch_stats"],
+                scope="t", allow_missing=False,
+            ),
+        }
+
+        x = np.random.default_rng(5).normal(size=(4, 16, 16, 3)).astype(
             np.float32
         )
         with torch.no_grad():
