@@ -109,6 +109,10 @@ def cifar_model_factories(num_classes: int = 10) -> Dict[str, Callable]:
         "resnet20_float": f(_make_cifar, "resnet20_float", (3, 3, 3), 16, "float", "identity", num_classes),
         "resnet34_float": f(_make_cifar, "resnet34_float", (3, 4, 6, 3), 64, "float", "identity", num_classes),
         "vgg_small": f(_make_vgg, num_classes),
+        # FP twin of vgg_small (same topology, FloatConv in place of the
+        # binary convs) — the KD teacher for VGG students; conv2..conv6
+        # pair name- and shape-matched for the layer KL
+        "vgg_small_float": f(_make_vgg, num_classes, variant="float"),
     }
 
 

@@ -103,6 +103,29 @@ class TestFitSmoke:
         )
         assert np.isfinite(res["best_acc1"])
 
+    def test_vgg_ts_with_float_twin_teacher(self, tmp_path):
+        """vgg_small distilled from its FP twin: the full 4-term TS loss
+        runs (conv2..conv6 pair shape-matched; stem skipped)."""
+        res = fit(
+            _cfg(
+                tmp_path,
+                arch="vgg_small",
+                imagenet_setting_step_2_ts=True,
+                arch_teacher="vgg_small_float",
+                allow_random_teacher=True,
+                react=False,
+                beta=0.01,
+            )
+        )
+        assert np.isfinite(res["best_acc1"])
+
+    def test_cifar100_end_to_end(self, tmp_path):
+        """The cifar100 recipe (reference loader.py:31-49: 100-way fc,
+        same augment constants) runs end-to-end, not just model init."""
+        res = fit(_cfg(tmp_path, dataset="cifar100"))
+        assert np.isfinite(res["best_acc1"])
+        assert res["best_acc1"] >= 0.0
+
     def test_evaluate_only_mode(self, tmp_path):
         """-e/--evaluate (reference train.py:376-379): restore a
         checkpoint, run ONE validation pass, return {'acc1'} without
