@@ -1,0 +1,13 @@
+#!/bin/bash
+# Serialize CPU-bound evidence runs on this 1-core host: when the VGG
+# KD run (run_kd.py pid given as $1) exits, launch the 150-epoch EDE
+# companion (tests the round-5 "schedule-budget" verdict: EDE anneals t
+# over the full epoch budget, so a longer budget stretches the anneal).
+cd /root/repo || exit 1
+while kill -0 "$1" 2>/dev/null; do sleep 60; done
+echo "$(date -u +%FT%TZ) KD run done; launching 150-epoch EDE companion" \
+  >> runs_r05/queue.log
+python run_accuracy.py --epochs 150 --ede --platform cpu \
+  --out ACCURACY_r05_ede150.json \
+  > runs_r05/ede150.out 2>&1
+echo "$(date -u +%FT%TZ) EDE-150 done rc=$?" >> runs_r05/queue.log

@@ -1,0 +1,52 @@
+#!/bin/bash
+# Round-5 TPU tunnel watcher (re-armed in the continuation session).
+# Probes the axon PJRT tunnel; on a live window captures, in order:
+#   1. profile_r05.py       -> profiles/r05/PROFILE_r05.json
+#   2. remat_ceiling.py     -> profiles/r05/REMAT_CEILING_r05.json
+#   3. bench.py             -> runs_r05/bench_fresh.json (one JSON line)
+# Each capture gets a generous timeout; a partial window still yields
+# whatever completed. Log: runs_r05/tpu_watch.log
+cd /root/repo || exit 1
+LOG=runs_r05/tpu_watch.log
+STAMP() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+echo "$(STAMP) watcher (re)armed pid $$" >> "$LOG"
+
+while true; do
+  if [ -f runs_r05/capture_done ]; then
+    echo "$(STAMP) all captures done; watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(STAMP) tunnel UP — starting capture sequence" >> "$LOG"
+    if [ ! -f profiles/r05/PROFILE_r05.json ]; then
+      echo "$(STAMP) capture 1/3: profile_r05.py" >> "$LOG"
+      timeout 2400 python profile_r05.py \
+        > runs_r05/profile_r05.out 2>&1
+      echo "$(STAMP) profile_r05 exit=$? (json: $(ls profiles/r05/PROFILE_r05.json 2>/dev/null || echo MISSING))" >> "$LOG"
+    fi
+    if [ -f profiles/r05/PROFILE_r05.json ] && [ ! -f profiles/r05/REMAT_CEILING_r05.json ]; then
+      echo "$(STAMP) capture 2/3: remat_ceiling.py" >> "$LOG"
+      timeout 3000 python remat_ceiling.py \
+        > runs_r05/remat_ceiling.out 2>&1
+      echo "$(STAMP) remat_ceiling exit=$? (json: $(ls profiles/r05/REMAT_CEILING_r05.json 2>/dev/null || echo MISSING))" >> "$LOG"
+    fi
+    if [ -f profiles/r05/PROFILE_r05.json ] && [ ! -f runs_r05/bench_fresh.json ]; then
+      echo "$(STAMP) capture 3/3: bench.py" >> "$LOG"
+      timeout 2400 python bench.py > runs_r05/bench_fresh.json 2> runs_r05/bench_fresh.err
+      rc=$?
+      echo "$(STAMP) bench exit=$rc" >> "$LOG"
+      # keep only a real fresh run; a dead-tunnel fallback prints value 0.0
+      if ! grep -q '"fresh_run": true' runs_r05/bench_fresh.json 2>/dev/null; then
+        mv runs_r05/bench_fresh.json runs_r05/bench_attempt_$(date +%s).json 2>/dev/null
+      fi
+    fi
+    if [ -f profiles/r05/PROFILE_r05.json ] && [ -f profiles/r05/REMAT_CEILING_r05.json ] && [ -f runs_r05/bench_fresh.json ]; then
+      touch runs_r05/capture_done
+      echo "$(STAMP) ALL CAPTURES COMPLETE" >> "$LOG"
+      exit 0
+    fi
+  else
+    echo "$(STAMP) tunnel down" >> "$LOG"
+  fi
+  sleep 300
+done
