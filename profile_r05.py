@@ -114,7 +114,7 @@ def main():
         "captured": datetime.datetime.now(datetime.timezone.utc).strftime(
             "%Y-%m-%dT%H:%MZ"
         )
-        + " on the attached chip",
+        + f" on {dev.device_kind} ({dev.platform})",
         "device_kind": dev.device_kind,
         "bf16_peak_tflops": peak,
         "trace_file": os.path.basename(trace_path) if trace_path else None,

@@ -72,6 +72,35 @@ def _find_run_dir(log_root):
     raise FileNotFoundError(f"no checkpoint under {log_root}")
 
 
+# no-KD headline artifact per student arch (equal budget/recipe minus
+# the TS terms); numbers are read from the named artifact at emit time
+# so they cannot drift from the file they cite
+_NO_KD_HEADLINES = {
+    "resnet20": "ACCURACY_r04.json",
+    "vgg_small": "ACCURACY_r05_vgg.json",
+}
+
+
+def _no_kd_reference(arch: str):
+    artifact = _NO_KD_HEADLINES.get(arch)
+    if artifact and os.path.exists(artifact):
+        with open(artifact) as f:
+            ref = json.load(f)
+        return {
+            "artifact": artifact,
+            "best_val_top1": ref.get("best_val_top1"),
+            "epochs": ref.get("epochs"),
+            "note": "same student arch/recipe minus the TS terms",
+        }
+    return {
+        "artifact": None,
+        "note": (
+            f"no same-arch no-KD headline recorded for {arch!r}; "
+            "compare against an equal-budget no-KD run of this arch"
+        ),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workdir", default="runs_r05/kd")
@@ -85,6 +114,9 @@ def main():
     ap.add_argument("--arch", default="resnet20",
                     help="binary student arch (resnet20_react + --react "
                     "= the config-4-shaped recipe)")
+    ap.add_argument("--teacher-arch", default="resnet20_float",
+                    help="FP teacher arch (e.g. vgg_small_float for the "
+                    "VGG-family KD companion)")
     ap.add_argument("--react", action="store_true",
                     help="reference react mode: beta=0, CE=0 — pure "
                     "logit distillation (ref train.py:605-609)")
@@ -124,11 +156,21 @@ def main():
     if os.path.exists(teacher_meta_path):
         with open(teacher_meta_path) as f:
             teacher_meta = json.load(f)
+        # a cached teacher must match the requested arch — silently
+        # reusing a different-arch teacher would distill from a teacher
+        # the user never asked for
+        if teacher_meta["arch"] != args.teacher_arch:
+            raise SystemExit(
+                f"workdir {args.workdir} holds a cached "
+                f"{teacher_meta['arch']} teacher but --teacher-arch is "
+                f"{args.teacher_arch}; use a fresh --workdir (or delete "
+                f"{teacher_meta_path}) to retrain"
+            )
     else:
         cfg_t = RunConfig(
             data=data_dir,
             dataset="cifar10",
-            arch="resnet20_float",
+            arch=args.teacher_arch,
             epochs=args.teacher_epochs,
             batch_size=args.batch,
             lr=args.teacher_lr,
@@ -140,7 +182,7 @@ def main():
         t0 = time.time()
         res_t = fit(cfg_t)
         teacher_meta = {
-            "arch": "resnet20_float",
+            "arch": args.teacher_arch,
             "epochs": args.teacher_epochs,
             "lr": args.teacher_lr,
             "opt_policy": "adam-linear",
@@ -168,7 +210,7 @@ def main():
         w_lambda_kurtosis=1.0,
         imagenet_setting_step_2_ts=True,
         react=args.react,
-        arch_teacher="resnet20_float",
+        arch_teacher=teacher_meta["arch"],
         resume_teacher=teacher_meta["ckpt_dir"],
         alpha=args.alpha,
         beta=args.beta,
@@ -201,11 +243,12 @@ def main():
 
     out = {
         "what": (
-            "first end-to-end teacher-student/KD accuracy artifact: "
-            "float-twin resnet20 teacher trained + checkpointed natively, "
-            "then BASELINE-config-2-shaped distillation of the binary "
-            "resnet20 student through fit() with the full 4-term TS loss "
-            "(beta*layerKL + alpha*logitKL + CE + lambda*kurt, reference "
+            "end-to-end teacher-student/KD accuracy artifact: "
+            f"float-twin {teacher_meta['arch']} teacher trained + "
+            "checkpointed natively, then BASELINE-config-2-shaped "
+            f"distillation of the binary {args.arch} student through "
+            "fit() with the full 4-term TS loss (beta*layerKL + "
+            "alpha*logitKL + CE + lambda*kurt, reference "
             "train.py:556-675) at equal budget to the no-KD headline"
         ),
         "dataset": "sklearn digits upsampled to CIFAR layout (same data "
@@ -233,12 +276,10 @@ def main():
             "w_kurtosis_target": 1.8,
             "wall_seconds": round(wall_s, 1),
         },
-        "no_kd_reference": {
-            "artifact": "ACCURACY_r04.json",
-            "best_val_top1": 97.77777777777777,
-            "epochs": 100,
-            "note": "same student arch/recipe minus the TS terms",
-        },
+        # the no-KD comparator must be the SAME student arch's headline;
+        # archs without a recorded no-KD headline get an explicit None
+        # rather than a mislabeled comparator
+        "no_kd_reference": _no_kd_reference(args.arch),
         "best_val_top1": res_s.get("best_acc1"),
         "best_epoch": res_s.get("best_epoch"),
         "time_to_target_s": res_s.get("time_to_target_s"),
