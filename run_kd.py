@@ -78,19 +78,41 @@ def _find_run_dir(log_root):
 _NO_KD_HEADLINES = {
     "resnet20": "ACCURACY_r04.json",
     "vgg_small": "ACCURACY_r05_vgg.json",
+    # lr 0.01 — the lr the react arch needs (lr 0.1 collapses it
+    # without a teacher, ACCURACY_r05_react_nokd.json); KD react runs
+    # at the same lr compare apples-to-apples
+    "resnet20_react": "ACCURACY_r05_react_nokd_lr001.json",
 }
 
 
-def _no_kd_reference(arch: str):
+def _no_kd_reference(arch: str, lr: float = None, epochs: int = None):
     artifact = _NO_KD_HEADLINES.get(arch)
     if artifact and os.path.exists(artifact):
         with open(artifact) as f:
             ref = json.load(f)
+        # an "equal recipe" claim requires verified-equal lr AND epoch
+        # budget; anything unverifiable or unequal gets spelled out
+        mismatches = []
+        for key, mine in (("lr", lr), ("epochs", epochs)):
+            theirs = ref.get(key)
+            if mine is None or theirs is None:
+                mismatches.append(f"{key} unverified")
+            elif mine != theirs:
+                mismatches.append(f"{key} {theirs} vs this run's {mine}")
+        if mismatches:
+            note = (
+                "same student arch minus the TS terms, but "
+                + ", ".join(mismatches)
+                + " — NOT a verified equal-recipe comparison"
+            )
+        else:
+            note = "same student arch/recipe minus the TS terms"
         return {
             "artifact": artifact,
             "best_val_top1": ref.get("best_val_top1"),
             "epochs": ref.get("epochs"),
-            "note": "same student arch/recipe minus the TS terms",
+            "lr": ref.get("lr"),
+            "note": note,
         }
     return {
         "artifact": None,
@@ -224,6 +246,17 @@ def main():
     res_s = fit(cfg_s)
     wall_s = time.time() - t0
 
+    # effective loss weights exactly as the jitted step resolves them
+    from bdbnn_tpu.train.state import StepConfig
+
+    _resolved_step = StepConfig(
+        teacher_student=True,
+        react=cfg_s.react,
+        alpha=cfg_s.alpha,
+        beta=cfg_s.beta,
+        w_lambda_ce=cfg_s.w_lambda_ce,
+    ).resolved()
+
     curves = _read_curves(
         student_root,
         (
@@ -271,7 +304,13 @@ def main():
             "lr": args.lr,
             "opt_policy": "adam-linear",
             "alpha": args.alpha,
-            "beta": args.beta,
+            # record the EFFECTIVE loss weights via the same resolution
+            # the step applies (react zeroes beta and the CE weight,
+            # ref train.py:605-609) so the artifact cannot drift from
+            # the step's actual math
+            "beta": _resolved_step.beta,
+            "w_lambda_ce": _resolved_step.w_lambda_ce,
+            "cli_beta": args.beta,
             "temperature": args.temperature,
             "w_kurtosis_target": 1.8,
             "wall_seconds": round(wall_s, 1),
@@ -279,7 +318,7 @@ def main():
         # the no-KD comparator must be the SAME student arch's headline;
         # archs without a recorded no-KD headline get an explicit None
         # rather than a mislabeled comparator
-        "no_kd_reference": _no_kd_reference(args.arch),
+        "no_kd_reference": _no_kd_reference(args.arch, args.lr, args.epochs),
         "best_val_top1": res_s.get("best_acc1"),
         "best_epoch": res_s.get("best_epoch"),
         "time_to_target_s": res_s.get("time_to_target_s"),
