@@ -85,7 +85,8 @@ _NO_KD_HEADLINES = {
 }
 
 
-def _no_kd_reference(arch: str, lr: float = None, epochs: int = None):
+def _no_kd_reference(arch: str, lr: float = None, epochs: int = None,
+                     dtype: str = None):
     artifact = _NO_KD_HEADLINES.get(arch)
     if artifact and os.path.exists(artifact):
         with open(artifact) as f:
@@ -93,7 +94,8 @@ def _no_kd_reference(arch: str, lr: float = None, epochs: int = None):
         # an "equal recipe" claim requires verified-equal lr AND epoch
         # budget; anything unverifiable or unequal gets spelled out
         mismatches = []
-        for key, mine in (("lr", lr), ("epochs", epochs)):
+        for key, mine in (("lr", lr), ("epochs", epochs),
+                          ("dtype", dtype)):
             theirs = ref.get(key)
             if mine is None or theirs is None:
                 mismatches.append(f"{key} unverified")
@@ -112,6 +114,7 @@ def _no_kd_reference(arch: str, lr: float = None, epochs: int = None):
             "best_val_top1": ref.get("best_val_top1"),
             "epochs": ref.get("epochs"),
             "lr": ref.get("lr"),
+            "dtype": ref.get("dtype"),
             "note": note,
         }
     return {
@@ -147,6 +150,11 @@ def main():
     ap.add_argument("--temperature", type=float, default=4.0)
     ap.add_argument("--out", default="ACCURACY_r05_ts.json")
     ap.add_argument("--platform", default="")
+    ap.add_argument("--dtype", default="float32",
+                    choices=("float32", "bfloat16"),
+                    help="student-phase compute dtype (teacher phase "
+                    "stays f32; the frozen teacher's forward runs in "
+                    "the student step's dtype)")
     args = ap.parse_args()
 
     if args.platform:
@@ -241,6 +249,7 @@ def main():
         print_freq=10,
         log_path=student_root,
         target_acc=90.0,
+        dtype=args.dtype,
     )
     t0 = time.time()
     res_s = fit(cfg_s)
@@ -302,6 +311,7 @@ def main():
             "react": args.react,
             "epochs": args.epochs,
             "lr": args.lr,
+            "dtype": args.dtype,
             "opt_policy": "adam-linear",
             "alpha": args.alpha,
             # record the EFFECTIVE loss weights via the same resolution
@@ -318,7 +328,9 @@ def main():
         # the no-KD comparator must be the SAME student arch's headline;
         # archs without a recorded no-KD headline get an explicit None
         # rather than a mislabeled comparator
-        "no_kd_reference": _no_kd_reference(args.arch, args.lr, args.epochs),
+        "no_kd_reference": _no_kd_reference(
+            args.arch, args.lr, args.epochs, args.dtype
+        ),
         "best_val_top1": res_s.get("best_acc1"),
         "best_epoch": res_s.get("best_epoch"),
         "time_to_target_s": res_s.get("time_to_target_s"),
