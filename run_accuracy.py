@@ -62,6 +62,9 @@ def main():
     # reference flag (default False, train.py:125) and its late-phase
     # sharp estimator destabilized small-dataset runs here
     ap.add_argument("--ede", action="store_true")
+    # --twoblock (ref train.py:143-144): alternate binary block
+    # variants through the net — see BiResNet.twoblock
+    ap.add_argument("--twoblock", action="store_true")
     ap.add_argument("--arch", default="resnet20")
     # both policies are the reference's own (train.py:316-336):
     # sgd-cosine is its CIFAR policy, adam-linear its ImageNet policy.
@@ -109,6 +112,7 @@ def main():
             w_kurtosis_target=1.8,
             w_lambda_kurtosis=1.0,
             ede=args.ede,
+            twoblock=args.twoblock,
             seed=0,
             print_freq=10,
             log_path=log_root,
@@ -144,7 +148,8 @@ def main():
         "what": (
             "first real-data accuracy point: BASELINE config 1 recipe "
             f"(binary {args.arch}, kurtosis target 1.8 lambda 1.0, "
-            f"{'EDE, ' if args.ede else ''}{args.opt_policy} (a "
+            f"{'EDE, ' if args.ede else ''}"
+            f"{'twoblock, ' if args.twoblock else ''}{args.opt_policy} (a "
             "reference optimizer policy, train.py:316-336), "
             f"lr {args.lr}, batch {args.batch}) trained end-to-end "
             "through fit() on real handwritten-digit images (sklearn "
@@ -170,6 +175,7 @@ def main():
         "dtype": args.dtype,
         "device_normalize": args.device_normalize,
         "ede": args.ede,
+        "twoblock": args.twoblock,
         "lr": args.lr,
         "arch": args.arch,
         "batch_size": args.batch,
