@@ -130,6 +130,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-dir", default="", type=str,
         help="write a jax.profiler trace of a few epoch-0 steps here",
     )
+    p.add_argument(
+        "--no-binarization-probes", dest="probe_binarization",
+        action="store_false",
+        help="disable the on-device per-layer sign-flip/kurtosis "
+        "probes (they ride inside the jitted step; manifest.json and "
+        "events.jsonl are written regardless)",
+    )
+    p.add_argument(
+        "--nonfinite-policy", default="raise",
+        choices=["raise", "warn", "ignore"],
+        help="what to do when a print interval drains a non-finite "
+        "train loss: fail fast (default), warn + record the event, or "
+        "skip detection",
+    )
     # legacy GPU/NCCL flags: accepted, ignored
     for flag, kw in [
         ("--world-size", dict(type=int, default=1)),
@@ -212,10 +226,43 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         input_backend=args.input_backend,
         target_acc=args.target_acc,
         profile_dir=args.profile_dir,
+        probe_binarization=args.probe_binarization,
+        nonfinite_policy=args.nonfinite_policy,
     )
 
 
+def summarize_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli summarize RUN_DIR [--json]`` — post-hoc
+    report over a run directory's manifest + scalars + events. Reads
+    files only; never initializes a JAX backend."""
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli summarize",
+        description="Render a post-hoc telemetry report for a run dir "
+        "(or a log root above it; the newest run wins).",
+    )
+    ap.add_argument("run_dir", help="run directory (or log root)")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable summary instead of the report",
+    )
+    args = ap.parse_args(argv)
+
+    from bdbnn_tpu.obs.summarize import summarize_run
+
+    report, summary = summarize_run(args.run_dir)
+    print(json.dumps(summary, indent=2) if args.json else report)
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommand dispatch ahead of the reference-compatible flag surface
+    # (a dataset dir named "summarize" would shadow it — none does)
+    if argv and argv[0] == "summarize":
+        return summarize_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
 

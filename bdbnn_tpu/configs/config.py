@@ -118,6 +118,17 @@ class RunConfig:
     profile_dir: str = ""
     profile_start: int = 5
     profile_steps: int = 5
+    # unified telemetry (obs/): fit() always writes manifest.json +
+    # events.jsonl. The on-device binarization probes (per-hooked-layer
+    # sign-flip rate + weight kurtosis, obs/probes.py) default ON for
+    # training runs; bench/profile harnesses build their own StepConfig
+    # and stay unperturbed.
+    probe_binarization: bool = True
+    # action when a drained print interval contained non-finite train
+    # losses: "raise" fails fast (a NaN epoch used to silently poison
+    # best-acc tracking), "warn" logs + records the event, "ignore"
+    # skips detection entirely (the step doesn't emit the flag)
+    nonfinite_policy: str = "raise"
 
     @property
     def num_classes(self) -> int:
@@ -140,6 +151,11 @@ class RunConfig:
             raise ValueError(f"unknown opt_policy {self.opt_policy!r}")
         if self.input_backend not in ("auto", "tfdata", "mp", "threads"):
             raise ValueError(f"unknown input_backend {self.input_backend!r}")
+        if self.nonfinite_policy not in ("raise", "warn", "ignore"):
+            raise ValueError(
+                f"unknown nonfinite_policy {self.nonfinite_policy!r} "
+                "(raise | warn | ignore)"
+            )
         if not 0.0 <= self.target_acc < 100.0:
             raise ValueError(
                 f"target_acc is a top-1 PERCENTAGE in [0, 100), got "

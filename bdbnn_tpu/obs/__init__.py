@@ -1,0 +1,60 @@
+"""Unified run telemetry (observability subsystem).
+
+One structured, machine-readable layer behind the three historical
+channels (logger text, ``ScalarWriter`` JSONL, ``--profile-dir``
+traces):
+
+- :mod:`bdbnn_tpu.obs.manifest` — ``manifest.json`` provenance
+- :mod:`bdbnn_tpu.obs.events`   — ``events.jsonl`` structured timeline
+- :mod:`bdbnn_tpu.obs.timing`   — host step-phase accounting
+- :mod:`bdbnn_tpu.obs.probes`   — on-device binarization health probes
+  (imported lazily by the train step; it needs jax)
+- :mod:`bdbnn_tpu.obs.summarize` — the ``summarize`` CLI's report engine
+
+This package root stays stdlib-importable: ``summarize`` must read run
+directories without initializing a JAX backend, so anything needing jax
+lives in :mod:`~bdbnn_tpu.obs.probes` and is NOT imported here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from bdbnn_tpu.obs.events import EVENTS_NAME, EventWriter, read_events
+from bdbnn_tpu.obs.manifest import (
+    MANIFEST_NAME,
+    RunManifest,
+    config_hash,
+    read_manifest,
+    write_manifest,
+)
+from bdbnn_tpu.obs.summarize import resolve_run_dir, summarize_run
+from bdbnn_tpu.obs.timing import StepPhaseTimer
+
+
+@dataclasses.dataclass
+class ObsHooks:
+    """The telemetry bundle fit() threads through its epoch loop."""
+
+    events: EventWriter
+    timer: StepPhaseTimer
+    # layer name -> weight count, for normalizing drained flip sums
+    probe_sizes: Dict[str, int]
+    nonfinite_policy: str = "raise"
+
+
+__all__ = [
+    "EVENTS_NAME",
+    "MANIFEST_NAME",
+    "EventWriter",
+    "ObsHooks",
+    "RunManifest",
+    "StepPhaseTimer",
+    "config_hash",
+    "read_events",
+    "read_manifest",
+    "resolve_run_dir",
+    "summarize_run",
+    "write_manifest",
+]

@@ -1,0 +1,102 @@
+"""``events.jsonl`` — the structured event channel.
+
+One append-only JSONL stream per run directory, shared by the train
+loop, validation and ``bench.py``. Where ``scalars.jsonl`` holds
+per-epoch (tag, value, step) points for curve plotting, events carry
+*structured records at arbitrary granularity* — per-interval step-phase
+timing, per-layer probe snapshots, compile time, non-finite incidents —
+each stamped with wall-clock time so post-hoc tools (the ``summarize``
+subcommand) can reconstruct a run's timeline without having watched it.
+
+Event kinds emitted by ``fit()``:
+
+- ``run_start``   — config hash, epochs, steps_per_epoch
+- ``compile``     — first-step trace+compile seconds (epoch 0 step 0)
+- ``train_interval`` — per print-interval: loss/top1/img_per_s,
+  data_wait/dispatch/drain seconds + shares, per-layer ``flip_rate``
+  and ``kurtosis`` dicts, ``grad_norm``
+- ``epoch``       — epoch train means + wall seconds
+- ``eval``        — per-validation acc1/acc5/loss
+- ``nonfinite``   — a drained interval contained non-finite losses
+- ``run_end``     — best acc/epoch, total wall seconds
+
+``bench.py`` adds ``bench_result`` records with the same envelope.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+EVENTS_NAME = "events.jsonl"
+
+
+def jsonsafe(obj: Any) -> Any:
+    """Recursively replace non-finite floats with None: bare ``NaN``
+    tokens are invalid RFC-8259 JSON (jq and most non-Python consumers
+    reject the whole line), and the ``nonfinite`` event kind already
+    carries the incident explicitly."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: jsonsafe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonsafe(v) for v in obj]
+    return obj
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Tolerant JSONL reader shared by the events and scalars channels:
+    blank and malformed lines (a crashed writer's torn tail) are
+    skipped, not fatal."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+class EventWriter:
+    """Append-only writer for ``<log_path>/events.jsonl``.
+
+    ``emit`` is cheap host work (one json.dumps + buffered write +
+    flush) — safe inside the hot loop's drain points, never between
+    async dispatches.
+    """
+
+    def __init__(self, log_path: str, name: str = EVENTS_NAME) -> None:
+        os.makedirs(log_path, exist_ok=True)
+        self.path = os.path.join(log_path, name)
+        self._f = open(self.path, "a")
+
+    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        rec = jsonsafe({"t": round(time.time(), 3), "kind": kind, **fields})
+        self._f.write(json.dumps(rec, default=repr) + "\n")
+        self._f.flush()
+        return rec
+
+    def close(self) -> None:
+        """Idempotent: fit() closes on every exit path."""
+        if not self._f.closed:
+            self._f.close()
+
+
+def read_events(
+    run_dir: str, kind: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Load a run dir's events, optionally filtered by kind."""
+    recs = read_jsonl(os.path.join(run_dir, EVENTS_NAME))
+    if kind is None:
+        return recs
+    return [r for r in recs if r.get("kind") == kind]

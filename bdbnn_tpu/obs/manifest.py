@@ -1,0 +1,138 @@
+"""Run provenance: one ``manifest.json`` per run directory.
+
+Every artifact JSON this repo emits (accuracy curves, bench lines,
+scalars) used to carry its own ad-hoc provenance blob — or none. The
+manifest centralizes it: config hash, JAX/jaxlib versions, device
+topology, process layout and backend are captured ONCE at ``fit()``
+start, so any consumer holding a run directory can answer "what code
+ran, on what hardware, with what config" without re-deriving it.
+
+Stdlib-only at import time; ``jax`` is imported inside
+:meth:`RunManifest.capture` so ``summarize`` (a pure file reader) never
+pays backend-init cost for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import socket
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+MANIFEST_SCHEMA_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+def config_hash(cfg: Any) -> str:
+    """Stable short hash of a run configuration.
+
+    Accepts the RunConfig dataclass, any dataclass, or a plain dict;
+    hashes the sorted-key JSON form so field order / tuple-vs-list
+    differences never change the hash.
+    """
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        payload = dataclasses.asdict(cfg)
+    elif isinstance(cfg, dict):
+        payload = cfg
+    else:
+        payload = dict(vars(cfg))
+    blob = json.dumps(payload, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class RunManifest:
+    """Reproducible-provenance record for one run directory."""
+
+    schema: int
+    created: str
+    created_unix: float
+    config_hash: str
+    config: Dict[str, Any]
+    jax_version: str
+    jaxlib_version: str
+    backend: str
+    device_kind: str
+    device_count: int
+    local_device_count: int
+    process_index: int
+    process_count: int
+    python: str
+    hostname: str
+    argv: List[str]
+
+    @classmethod
+    def capture(cls, cfg: Any) -> "RunManifest":
+        """Snapshot the live process + backend + ``cfg``."""
+        import jax
+
+        try:
+            import jaxlib
+
+            jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+        except Exception:
+            jaxlib_version = "unknown"
+        dev = jax.devices()[0]
+        if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+            cfg_dict = dataclasses.asdict(cfg)
+        else:
+            cfg_dict = dict(cfg) if isinstance(cfg, dict) else dict(vars(cfg))
+        now = time.time()
+        return cls(
+            schema=MANIFEST_SCHEMA_VERSION,
+            created=time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(now)),
+            created_unix=round(now, 3),
+            config_hash=config_hash(cfg_dict),
+            config=cfg_dict,
+            jax_version=jax.__version__,
+            jaxlib_version=jaxlib_version,
+            backend=dev.platform,
+            device_kind=dev.device_kind,
+            device_count=jax.device_count(),
+            local_device_count=jax.local_device_count(),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            python=sys.version.split()[0],
+            hostname=socket.gethostname(),
+            argv=list(sys.argv),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        # round-trip through JSON so tuples in the config become lists —
+        # to_dict(capture(cfg)) == read_manifest(dir) byte-for-byte
+        return json.loads(json.dumps(dataclasses.asdict(self), default=repr))
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RunManifest":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+
+def write_manifest(
+    log_path: str, cfg: Any, extra: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """Capture + atomically write ``<log_path>/manifest.json``; returns
+    the written dict."""
+    man = RunManifest.capture(cfg).to_dict()
+    if extra:
+        man.update(extra)
+    os.makedirs(log_path, exist_ok=True)
+    path = os.path.join(log_path, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=2, default=repr)
+    os.replace(tmp, path)
+    return man
+
+
+def read_manifest(run_dir: str) -> Optional[Dict[str, Any]]:
+    """Load ``manifest.json`` from a run dir; None when absent."""
+    path = os.path.join(run_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)

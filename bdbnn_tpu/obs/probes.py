@@ -1,0 +1,88 @@
+"""On-device binarization health probes.
+
+Binary-network training fails in ways epoch-mean loss curves can't
+show: latent weight distributions that never go bimodal despite the
+kurtosis regularizer, and sign-flip churn — binarized weights
+oscillating across zero step after step — that stalls convergence (the
+instability XNOR-Net and the original BNN paper mitigate with scale
+factors and STE clipping; PAPERS.md arXiv:1603.05279, 1602.02830).
+
+The probes here are pure ``jnp`` expressions evaluated INSIDE the
+already-jitted train step and accumulated by the existing
+``DeviceMetrics`` sums, so they cost zero extra host syncs:
+
+- ``flips/<layer>``  — count of latent weights whose sign changed in
+  this optimizer update. Summed over a print interval and divided by
+  (layer size × interval steps) on the host, it is the per-step
+  fraction of binarized weights that flipped ("flip rate").
+- ``kurt/<layer>``   — Bessel-corrected kurtosis of the layer's latent
+  weights after the update (same estimator as the training loss,
+  ``losses/kurtosis.py``). Interval mean ≈ how bimodal the layer
+  actually is vs its target.
+- ``nonfinite``      — 1 when the step's total loss is not finite.
+  Drained at interval granularity and fed to the configurable
+  fail-fast policy (a NaN epoch previously poisoned best-acc tracking
+  silently).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bdbnn_tpu.losses.kurtosis import kurtosis
+from bdbnn_tpu.models.resnet import get_by_path
+
+FLIP_PREFIX = "flips/"
+KURT_PREFIX = "kurt/"
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised (policy 'raise') when a drained interval contained
+    non-finite train losses."""
+
+
+def probe_metrics(
+    old_params,
+    new_params,
+    probe_paths: Sequence[Tuple[str, ...]],
+    probe_names: Sequence[str],
+) -> Dict[str, jax.Array]:
+    """Per-hooked-layer sign-flip counts + kurtosis, as DeviceMetrics-
+    summable scalars. Traced into the jitted step; adds no host work."""
+    out: Dict[str, jax.Array] = {}
+    for path, name in zip(probe_paths, probe_names):
+        w_old = get_by_path(old_params, path)
+        w_new = get_by_path(new_params, path)
+        out[FLIP_PREFIX + name] = jnp.sum(
+            (jnp.sign(w_old) != jnp.sign(w_new)).astype(jnp.float32)
+        )
+        out[KURT_PREFIX + name] = kurtosis(w_new)
+    return out
+
+
+def nonfinite_flag(loss: jax.Array) -> jax.Array:
+    """1 iff the step's loss is NaN/Inf (int32, DeviceMetrics-summable)."""
+    return jnp.logical_not(jnp.isfinite(loss)).astype(jnp.int32)
+
+
+def drain_probe_report(
+    sums: Dict[str, float],
+    probe_sizes: Dict[str, int],
+    interval_steps: int,
+) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Host-side: turn drained probe SUMS into per-layer per-step flip
+    rates and interval-mean kurtosis."""
+    steps = max(interval_steps, 1)
+    flip_rate = {}
+    kurt = {}
+    for name, size in probe_sizes.items():
+        f = sums.get(FLIP_PREFIX + name)
+        if f is not None:
+            flip_rate[name] = f / (max(size, 1) * steps)
+        k = sums.get(KURT_PREFIX + name)
+        if k is not None:
+            kurt[name] = k / steps
+    return flip_rate, kurt

@@ -51,6 +51,8 @@ from bdbnn_tpu.models import (
     module_path_str,
 )
 from bdbnn_tpu.models.torch_import import load_torch_checkpoint
+from bdbnn_tpu.obs import EventWriter, ObsHooks, StepPhaseTimer, write_manifest
+from bdbnn_tpu.obs.probes import NonFiniteLossError, drain_probe_report
 from bdbnn_tpu.parallel import (
     create_sharded_state,
     jit_train_step,
@@ -385,6 +387,17 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     _resources.append(writer)
     logger.info("config: %s", cfg)
 
+    # unified telemetry: provenance manifest + structured event channel
+    # live next to log.txt/scalars.jsonl from the first moment of the
+    # run, so even a crashed run is diagnosable post hoc (`summarize`)
+    manifest = write_manifest(log_path, cfg)
+    events = EventWriter(log_path)
+    _resources.append(events)
+    logger.info(
+        "telemetry: manifest.json + events.jsonl in %s (config %s)",
+        log_path, manifest["config_hash"],
+    )
+
     if cfg.seed is not None:
         np.random.seed(cfg.seed)
 
@@ -452,6 +465,23 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         else ()
     )
 
+    # binarization probes ride on the kurtosis hook selection; runs
+    # without kurtosis hooks probe every non-stem conv (the same "all"
+    # convention select_hooked_paths uses)
+    probe_paths: tuple = ()
+    if cfg.probe_binarization and not cfg.arch.endswith("_float"):
+        # float twins (teacher training) have no binarization to probe;
+        # skipping them also keeps the per-step kurtosis pass out of
+        # runs it can't inform
+        probe_paths = hooked or tuple(
+            conv_weight_paths(variables["params"])[1:]
+        )
+    probe_names = tuple(module_path_str(p) for p in probe_paths)
+    probe_sizes = {
+        n: int(np.prod(get_by_path(variables["params"], p).shape))
+        for n, p in zip(probe_names, probe_paths)
+    }
+
     input_norm = None
     if cfg.device_normalize:
         from bdbnn_tpu.data import (
@@ -489,6 +519,9 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         # fit() runs want the starvation probe; bench/profile build
         # their own StepConfig and measure the unperturbed step
         log_grad_norm=True,
+        probe_paths=probe_paths,
+        probe_names=probe_names,
+        track_nonfinite=cfg.nonfinite_policy != "ignore",
     )
 
     teacher_variables = None
@@ -619,10 +652,18 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             best_acc1 = restored["best_acc1"]
         logger.info("resumed from %s at epoch %d", cfg.resume, start_epoch)
 
+    obs = ObsHooks(
+        events=events,
+        timer=StepPhaseTimer(),
+        probe_sizes=probe_sizes,
+        nonfinite_policy=cfg.nonfinite_policy,
+    )
+
     if cfg.evaluate:
         acc1 = _validate(
             eval_step, state, val_pipe, mesh, logger, writer, 0,
-            fill_dtype=eval_fill_dtype,
+            fill_dtype=eval_fill_dtype, events=events,
+            nonfinite_policy=cfg.nonfinite_policy,
         )
         return {"acc1": acc1}
 
@@ -640,6 +681,15 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             "wall-clock unknown", start_epoch,
         )
 
+    events.emit(
+        "run_start",
+        config_hash=manifest["config_hash"],
+        start_epoch=start_epoch,
+        epochs=cfg.epochs,
+        steps_per_epoch=steps_per_epoch,
+        probed_layers=list(probe_sizes),
+    )
+
     for epoch in range(start_epoch, cfg.epochs):
         t, k = cpt_tk(epoch, cfg.epochs) if cfg.ede else (1.0, 1.0)
         if cfg.ede:
@@ -653,11 +703,12 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
 
         state = _train_epoch(
             train_step, state, train_pipe, mesh, epoch, tk, kurt_gate,
-            cfg, steps_per_epoch, logger, writer,
+            cfg, steps_per_epoch, logger, writer, obs=obs,
         )
         acc1 = _validate(
             eval_step, state, val_pipe, mesh, logger, writer, epoch,
-            fill_dtype=eval_fill_dtype,
+            fill_dtype=eval_fill_dtype, events=events,
+            nonfinite_policy=cfg.nonfinite_policy,
         )
 
         if (
@@ -686,6 +737,17 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             epoch=epoch, arch=cfg.arch, best_acc1=best_acc1, is_best=is_best,
         )
 
+    events.emit(
+        "run_end",
+        best_acc1=best_acc1,
+        best_epoch=best_epoch,
+        wall_s=round(time.time() - t_fit, 1),
+        **(
+            {"time_to_target_s": round(time_to_target, 1)}
+            if time_to_target is not None
+            else {}
+        ),
+    )
     writer.close()
     out = {"best_acc1": best_acc1, "best_epoch": float(best_epoch)}
     if time_to_target is not None:
@@ -693,34 +755,136 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     return out
 
 
+def _apply_nonfinite_policy(policy, logger, events, msg, **fields):
+    """cfg.nonfinite_policy at a detection site: record the event, then
+    raise / warn / stay silent."""
+    if events is not None:
+        events.emit("nonfinite", policy=policy, message=msg, **fields)
+    if policy == "raise":
+        raise NonFiniteLossError(
+            msg + " (nonfinite_policy='raise'; pass --nonfinite-policy "
+            "warn to keep going)"
+        )
+    if policy == "warn":
+        logger.warning("%s (nonfinite_policy='warn')", msg)
+
+
+def _interval_observe(
+    obs, logger, epoch, step_idx, interval_steps, sums, n, rate, probe_m
+):
+    """Drain-time telemetry: the non-finite fail-fast check, per-layer
+    probe folding, and the ``train_interval`` event. Pure host work on
+    the already-fetched float sums — no device syncs."""
+    if obs is None:
+        return
+    bad = int(sums.get("nonfinite", 0))
+    if bad:
+        _apply_nonfinite_policy(
+            obs.nonfinite_policy, logger, obs.events,
+            f"non-finite train loss in {bad}/{interval_steps} step(s) of "
+            f"the interval ending at epoch {epoch} step {step_idx}",
+            epoch=epoch, step=step_idx, bad_steps=bad, where="train",
+        )
+    flip_rate, kurt = drain_probe_report(
+        sums, obs.probe_sizes, interval_steps
+    )
+    for name, v in flip_rate.items():
+        probe_m.setdefault(f"Probe flip {name}", Mean(name)).add(
+            v, interval_steps
+        )
+    for name, v in kurt.items():
+        probe_m.setdefault(f"Probe kurt {name}", Mean(name)).add(
+            v, interval_steps
+        )
+    obs.events.emit(
+        "train_interval",
+        epoch=epoch,
+        step=step_idx,
+        steps=interval_steps,
+        loss=round(sums["loss_sum"] / n, 6),
+        top1=round(100.0 * sums["top1"] / n, 3),
+        img_per_s=round(rate, 2),
+        **(
+            {"grad_norm": round(sums["grad_norm"] / interval_steps, 6)}
+            if "grad_norm" in sums
+            else {}
+        ),
+        **obs.timer.snapshot(),
+        **(
+            {"flip_rate": {k: round(v, 8) for k, v in flip_rate.items()}}
+            if flip_rate
+            else {}
+        ),
+        **(
+            {"kurtosis": {k: round(v, 4) for k, v in kurt.items()}}
+            if kurt
+            else {}
+        ),
+    )
+
+
 def _train_epoch(
     train_step, state, pipe, mesh, epoch, tk, kurt_gate, cfg,
-    steps_per_epoch, logger, writer,
+    steps_per_epoch, logger, writer, obs=None,
 ):
     """One epoch. The hot loop never syncs with the device: metrics go
     into a lazy on-device accumulator and are drained once every
     ``print_freq`` steps (vs the reference's per-batch ``.item()``,
-    ``train.py:518-524``)."""
+    ``train.py:518-524``). Telemetry rides the SAME cadence: step-phase
+    wall time is perf_counter deltas around calls the loop already
+    makes, probes come back inside the drained sums, and events are
+    emitted only at drain points — the drain count per epoch is
+    identical with obs on or off (pinned by tests/test_obs.py)."""
     devmet = DeviceMetrics()
     loss_m = Mean("Loss", "{:.4e}")
     top1_m = Mean("Acc@1", "{:6.2f}")
     top5_m = Mean("Acc@5", "{:6.2f}")
     comp_m: Dict[str, Mean] = {}
+    probe_m: Dict[str, Mean] = {}
     thr = Throughput()
     progress = ProgressLog(steps_per_epoch, logger, prefix=f"Epoch: [{epoch}]")
     n_chips = max(jax.device_count(), 1)
+    timer = obs.timer if obs is not None else None
 
     profiling = bool(cfg.profile_dir) and epoch == 0
     trace_active = False
     t_epoch = time.time()
 
-    for step_idx, (x, y) in enumerate(pipe.epoch(epoch)):
+    if timer is not None:
+        # the timer persists across epochs: drop the eval/checkpoint
+        # wall between epochs so it can't dilute the first interval's
+        # data-wait share
+        timer.reset()
+    it = iter(pipe.epoch(epoch))
+    step_idx = -1
+    while True:
+        t_mark = time.perf_counter()
+        try:
+            x, y = next(it)
+        except StopIteration:
+            break
+        step_idx += 1
+        if timer is not None:
+            timer.add("data_wait", time.perf_counter() - t_mark)
         if profiling and not trace_active and step_idx == cfg.profile_start:
             jax.profiler.start_trace(cfg.profile_dir)
             trace_active = True
+        t_mark = time.perf_counter()
         gx, gy = shard_batch(mesh, x, y)
         state, m = train_step(state, (gx, gy), tk, kurt_gate)
         devmet.add(m)
+        t_done = time.perf_counter()
+        if timer is not None:
+            timer.add("dispatch", t_done - t_mark)
+            if step_idx == 0 and timer.compile_s is None:
+                # the process's first call blocks the host on
+                # trace+compile (also when resuming at start_epoch>0);
+                # subsequent dispatches are sub-ms async enqueues, so
+                # this host-side duration IS the compile cost
+                timer.record_compile(t_done - t_mark)
+                obs.events.emit(
+                    "compile", seconds=round(t_done - t_mark, 3)
+                )
         if (
             trace_active
             and step_idx >= cfg.profile_start + cfg.profile_steps - 1
@@ -732,7 +896,10 @@ def _train_epoch(
 
         if step_idx % cfg.print_freq == 0:
             interval_steps = devmet.pending_steps
+            t_mark = time.perf_counter()
             sums = devmet.drain()  # the ONE host sync per interval
+            if timer is not None:
+                timer.add("drain", time.perf_counter() - t_mark)
             n = max(sums["count"], 1.0)
             _add_component_means(comp_m, sums, interval_steps)
             # loss_sum is example-weighted at the step (loss × count), so
@@ -742,6 +909,10 @@ def _train_epoch(
             top1_m.add(100.0 * sums["top1"] / n, n)
             top5_m.add(100.0 * sums["top5"] / n, n)
             rate = thr.tick(n)
+            _interval_observe(
+                obs, logger, epoch, step_idx, interval_steps, sums, n,
+                rate, probe_m,
+            )
             progress.emit(
                 step_idx,
                 [
@@ -766,13 +937,20 @@ def _train_epoch(
     # final partial interval + epoch means
     if devmet.pending_steps:
         interval_steps = devmet.pending_steps
+        t_mark = time.perf_counter()
         sums = devmet.drain()
+        if timer is not None:
+            timer.add("drain", time.perf_counter() - t_mark)
         n = max(sums["count"], 1.0)
         _add_component_means(comp_m, sums, interval_steps)
         loss_m.add(sums["loss_sum"] / n, n)
         top1_m.add(100.0 * sums["top1"] / n, n)
         top5_m.add(100.0 * sums["top5"] / n, n)
-        thr.tick(n)
+        rate = thr.tick(n)
+        _interval_observe(
+            obs, logger, epoch, step_idx, interval_steps, sums, n, rate,
+            probe_m,
+        )
     # epoch means (Appendix B #15 fix: mean, not last batch)
     writer.add_scalar("Train Loss", loss_m.mean, epoch)
     writer.add_scalar("Train Acc1", top1_m.mean, epoch)
@@ -783,6 +961,19 @@ def _train_epoch(
     # 4-term TS loss (reference train.py:596-611) stays finite
     for key, meter in sorted(comp_m.items()):
         writer.add_scalar(f"Train {key}", meter.mean, epoch)
+    # per-layer probe trajectories ("Probe flip <layer>" / "Probe kurt
+    # <layer>") — the flip-rate/kurtosis curves `summarize` renders
+    for key, meter in sorted(probe_m.items()):
+        writer.add_scalar(key, meter.mean, epoch)
+    if obs is not None:
+        obs.events.emit(
+            "epoch",
+            epoch=epoch,
+            loss=round(loss_m.mean, 6),
+            top1=round(top1_m.mean, 3),
+            img_per_s_chip=round(thr.cumulative / n_chips, 2),
+            wall_s=round(time.time() - t_epoch, 3),
+        )
     return state
 
 
@@ -815,7 +1006,7 @@ def _pad_eval_batch(x, y, batch_size):
 
 
 def _validate(eval_step, state, pipe, mesh, logger, writer, epoch,
-              fill_dtype=np.float32):
+              fill_dtype=np.float32, events=None, nonfinite_policy=None):
     """Mesh-sharded validation with global metrics (↔ ``validate()``,
     ``train.py:677-714``; the reference reduced nothing across ranks).
     Batches are padded to the pipeline batch size and masked, so one
@@ -858,4 +1049,23 @@ def _validate(eval_step, state, pipe, mesh, logger, writer, epoch,
     writer.add_scalar("Val Loss", loss_sum / count, epoch)
     writer.add_scalar("Val Acc1", acc1, epoch)
     writer.add_scalar("Val Acc5", acc5, epoch)
+    if events is not None:
+        events.emit(
+            "eval",
+            epoch=epoch,
+            acc1=round(acc1, 4),
+            acc5=round(acc5, 4),
+            loss=round(loss_sum / count, 6),
+        )
+    # the loss is the eval-side NaN signal (acc1 is a ratio of boolean
+    # correct-counts and is finite for any weights); "ignore" mirrors
+    # the train side, where it disables detection entirely
+    if nonfinite_policy not in (None, "ignore") and not np.isfinite(
+        loss_sum
+    ):
+        _apply_nonfinite_policy(
+            nonfinite_policy, logger, events,
+            f"non-finite validation loss at epoch {epoch}",
+            epoch=epoch, where="eval",
+        )
     return acc1

@@ -83,6 +83,16 @@ class StepConfig:
     # #5). Default OFF so bench/profile workloads that build StepConfig
     # directly measure the unperturbed step; fit() turns it on.
     log_grad_norm: bool = False
+    # binarization health probes (obs/probes.py): per-layer sign-flip
+    # counts + latent-weight kurtosis, computed in the jitted step and
+    # drained with the existing DeviceMetrics sums. Same default-OFF
+    # rationale as log_grad_norm; fit() populates these from the hooked
+    # kurtosis layers (or every non-stem conv when no hooks).
+    probe_paths: Tuple[Tuple[str, ...], ...] = ()
+    probe_names: Tuple[str, ...] = ()
+    # emit metrics['nonfinite'] (1 per step with a NaN/Inf loss) for the
+    # drain-time fail-fast policy
+    track_nonfinite: bool = False
     # device-side input normalization (TPU-first input path): when set
     # to per-channel ((mean,...), (std,...)) in 0-1 scale, the step
     # receives RAW uint8 NHWC batches and normalizes on device — the

@@ -36,6 +36,7 @@ from bdbnn_tpu.losses.kurtosis import (
     weight_to_pm1_regularization,
 )
 from bdbnn_tpu.models.resnet import get_by_path
+from bdbnn_tpu.obs.probes import nonfinite_flag, probe_metrics
 from bdbnn_tpu.train.state import StepConfig, TrainState
 
 Array = jax.Array
@@ -99,6 +100,47 @@ def _regularization_terms(params, cfg: StepConfig, kurt_gate: Array):
     return total, terms
 
 
+def _step_metrics(
+    aux: Dict[str, Array],
+    logits: Array,
+    labels: Array,
+    grads,
+    old_params,
+    new_params,
+    cfg: StepConfig,
+) -> Dict[str, Array]:
+    """Assemble the per-step metric dict (shared by the plain and TS
+    steps): loss terms, example-weighted loss sum, top-k counts, and —
+    per StepConfig — the grad-norm, binarization-probe and non-finite
+    observability signals. Everything is a DeviceMetrics-summable
+    on-device scalar; nothing here syncs the host."""
+    metrics = {
+        **aux,
+        # example-weighted sum: epoch means must weight each step by
+        # its example count, not average per-step means (which skews
+        # when the final print interval is shorter — VERDICT r3 #6)
+        "loss_sum": aux["loss"] * labels.shape[0],
+        # global gradient norm (cfg.log_grad_norm): the direct probe
+        # for estimator starvation (EDE's backward k·t·sech²(t·x) → 0
+        # a.e. as t anneals to 10 — VERDICT r4 weak #5)
+        **(
+            {"grad_norm": optax.global_norm(grads)}
+            if cfg.log_grad_norm
+            else {}
+        ),
+        **topk_correct(logits, labels),
+        "count": jnp.int32(labels.shape[0]),
+    }
+    if cfg.probe_paths:
+        metrics.update(
+            probe_metrics(old_params, new_params, cfg.probe_paths,
+                          cfg.probe_names)
+        )
+    if cfg.track_nonfinite:
+        metrics["nonfinite"] = nonfinite_flag(aux["loss"])
+    return metrics
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -131,24 +173,9 @@ def make_train_step(
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         logits = aux.pop("logits")
-        metrics = {
-            **aux,
-            # example-weighted sum: epoch means must weight each step by
-            # its example count, not average per-step means (which skews
-            # when the final print interval is shorter — VERDICT r3 #6)
-            "loss_sum": aux["loss"] * labels.shape[0],
-            # global gradient norm (cfg.log_grad_norm): the direct
-            # probe for estimator starvation (EDE's backward
-            # k·t·sech²(t·x) → 0 a.e. as t anneals to 10 — VERDICT r4
-            # weak #5 asked for exactly this signal per epoch)
-            **(
-                {"grad_norm": optax.global_norm(grads)}
-                if cfg.log_grad_norm
-                else {}
-            ),
-            **topk_correct(logits, labels),
-            "count": jnp.int32(labels.shape[0]),
-        }
+        metrics = _step_metrics(
+            aux, logits, labels, grads, state.params, new_params, cfg
+        )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -225,17 +252,9 @@ def make_ts_train_step(
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         logits = aux.pop("logits")
-        metrics = {
-            **aux,
-            "loss_sum": aux["loss"] * labels.shape[0],
-            **(
-                {"grad_norm": optax.global_norm(grads)}
-                if cfg.log_grad_norm
-                else {}
-            ),
-            **topk_correct(logits, labels),
-            "count": jnp.int32(labels.shape[0]),
-        }
+        metrics = _step_metrics(
+            aux, logits, labels, grads, state.params, new_params, cfg
+        )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

@@ -5,6 +5,11 @@ Reference channels (SURVEY.md §5.5): (a) python logging to console +
 lines. Here (b) degrades gracefully to a JSONL scalar log when
 tensorboard isn't available — same data, judge-greppable.
 
+These are two of the three unified telemetry channels (docs/design.md
+§6): ``bdbnn_tpu/obs`` adds ``manifest.json`` + ``events.jsonl``
+alongside and its ``summarize`` reader consumes :data:`SCALARS_NAME`
+from the same run directory.
+
 Epoch-mean fix (Appendix B #15): ``log_epoch_scalars`` writes the
 epoch-mean train loss, not the last batch's.
 """

@@ -38,6 +38,10 @@ class DeviceMetrics:
     def __init__(self) -> None:
         self._acc: Optional[Dict[str, jax.Array]] = None
         self._steps = 0
+        # lifetime count of REAL host syncs (drains that fetched) — the
+        # invariant telemetry must not change: one per print interval
+        # (tests/test_obs.py pins it)
+        self.drain_count = 0
 
     def add(self, metrics: Dict[str, jax.Array]) -> None:
         if self._acc is None:
@@ -59,6 +63,7 @@ class DeviceMetrics:
         fetched = jax.device_get(self._acc)
         self._acc = None
         self._steps = 0
+        self.drain_count += 1
         return {k: float(v) for k, v in fetched.items()}
 
 

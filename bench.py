@@ -242,6 +242,20 @@ def worker_main(args) -> None:
     peak_tflops = BF16_PEAK_TFLOPS.get(device_kind)
     print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
 
+    # the shared structured event channel (obs/events.py): bench rounds
+    # land in <profile_dir>/events.jsonl with the same envelope fit()
+    # uses, so `summarize`-grade tooling can read bench history too.
+    # Telemetry must never break a measurement — any writer failure
+    # (read-only dir, etc.) downgrades to events=None.
+    events = None
+    if args.profile_dir:
+        try:
+            from bdbnn_tpu.obs import EventWriter
+
+            events = EventWriter(args.profile_dir)
+        except Exception as e:
+            print(f"[bench] event channel disabled: {e}", file=sys.stderr)
+
     # Staged measurement, emitting a cumulative JSON line after every
     # stage: if the driver's timeout kills us mid-way, the parent still
     # scavenges the last complete line.
@@ -280,6 +294,8 @@ def worker_main(args) -> None:
             out["mfu"] = round(achieved / (peak_tflops * 1e12), 4)
             out["timing_suspect"] = bool(out["mfu"] > 1.0)
         print(json.dumps(out), flush=True)
+        if events is not None:
+            events.emit("bench_result", **out)
 
     with default_impl("dot"):
         compiled, state, batch_xy, tk, gate, flops = _compile_step(

@@ -36,10 +36,14 @@ import pytest  # noqa: E402
 # stays the default.
 _FAST_MODULES = {"test_binarize", "test_kurtosis", "test_kd", "test_cli"}
 _FAST_CLASSES = {"TestOptimizerParity", "TestEDESchedule"}
+# in fast modules but not fast: real subprocesses that import jax
+_NOT_FAST_CLASSES = {"TestSummarizeSubcommand"}
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        if item.cls is not None and item.cls.__name__ in _NOT_FAST_CLASSES:
+            continue
         if (
             item.module.__name__ in _FAST_MODULES
             or (item.cls is not None and item.cls.__name__ in _FAST_CLASSES)
@@ -50,3 +54,93 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def _write_fixture_run_dir(path):
+    """A hand-built telemetry run dir (manifest + scalars + events)
+    matching the schemas fit() writes — used by the summarize tests in
+    test_obs.py and the CLI subprocess smoke in test_cli.py. Built from
+    files alone on purpose: `summarize` must work on a run dir with no
+    live process behind it."""
+    import json
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    manifest = {
+        "schema": 1,
+        "created": "2026-08-01T00:00:00",
+        "created_unix": 1785542400.0,
+        "config_hash": "deadbeef00112233",
+        "config": {"arch": "resnet20", "epochs": 3},
+        "jax_version": "0.4.37",
+        "jaxlib_version": "0.4.36",
+        "backend": "cpu",
+        "device_kind": "cpu",
+        "device_count": 8,
+        "local_device_count": 8,
+        "process_index": 0,
+        "process_count": 1,
+        "python": "3.11.0",
+        "hostname": "fixture",
+        "argv": ["cli"],
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    scalars = []
+    for epoch in range(3):
+        scalars += [
+            {"tag": "Train Loss", "value": 2.0 - 0.5 * epoch, "step": epoch},
+            {"tag": "Train loss_ce", "value": 1.9 - 0.5 * epoch, "step": epoch},
+            {"tag": "Train loss_kurt", "value": 0.1, "step": epoch},
+            {"tag": "Train grad_norm", "value": 2.0 / (1 + epoch), "step": epoch},
+            {"tag": "Val Acc1", "value": 30.0 * (1 + epoch), "step": epoch},
+            {"tag": "Probe flip layer1_0.conv1", "value": 1e-3 / (1 + epoch),
+             "step": epoch},
+            {"tag": "Probe kurt layer1_0.conv1", "value": 2.5 - 0.2 * epoch,
+             "step": epoch},
+        ]
+    with open(os.path.join(path, "scalars.jsonl"), "w") as f:
+        for s in scalars:
+            f.write(json.dumps(s) + "\n")
+    events = [
+        {"t": 100.0, "kind": "run_start", "config_hash": "deadbeef00112233",
+         "start_epoch": 0, "epochs": 3, "steps_per_epoch": 4,
+         "probed_layers": ["layer1_0.conv1"]},
+        {"t": 105.0, "kind": "compile", "seconds": 5.0},
+    ]
+    t = 105.0
+    for epoch in range(3):
+        for step in (0, 2, 3):
+            t += 2.0
+            events.append({
+                "t": t, "kind": "train_interval", "epoch": epoch,
+                "step": step, "steps": 2 if step == 2 else 1,
+                "loss": 2.0 - 0.5 * epoch, "top1": 25.0, "img_per_s": 100.0,
+                "grad_norm": 2.0 / (1 + epoch),
+                "data_wait_s": 1.0, "dispatch_s": 0.5, "drain_s": 0.5,
+                "interval_s": 2.0, "data_wait_share": 0.5,
+                "flip_rate": {"layer1_0.conv1": 1e-3 / (1 + epoch)},
+                "kurtosis": {"layer1_0.conv1": 2.5 - 0.2 * epoch},
+            })
+        t += 1.0
+        events.append({"t": t, "kind": "epoch", "epoch": epoch,
+                       "loss": 2.0 - 0.5 * epoch, "top1": 25.0,
+                       "img_per_s_chip": 12.5, "wall_s": 7.0})
+        t += 1.0
+        events.append({"t": t, "kind": "eval", "epoch": epoch,
+                       "acc1": 30.0 * (1 + epoch), "acc5": 80.0,
+                       "loss": 1.5 - 0.4 * epoch})
+    events.append({"t": t + 1.0, "kind": "run_end", "best_acc1": 90.0,
+                   "best_epoch": 2, "wall_s": t - 99.0})
+    with open(os.path.join(path, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+@pytest.fixture
+def fixture_run_dir(tmp_path):
+    """A synthetic run dir with one hooked layer, 3 epochs of scalars,
+    and a full event timeline whose phase timing reads input-bound
+    (data-wait share 0.5)."""
+    return _write_fixture_run_dir(str(tmp_path / "run"))

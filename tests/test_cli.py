@@ -6,6 +6,11 @@ MIGRATION.md promises every reference flag parses here with the same
 spelling and default; these tests pin that promise.
 """
 
+import json
+import os
+import subprocess
+import sys
+
 import pytest
 
 from bdbnn_tpu.cli import args_to_config, build_parser
@@ -123,3 +128,46 @@ class TestTpuNativeFlags:
     def test_bad_dataset_rejected(self):
         with pytest.raises(SystemExit):
             build_parser().parse_args(["/d", "--dataset", "mnist"])
+
+    def test_telemetry_flags(self):
+        cfg = parse(["/data", "--no-binarization-probes",
+                     "--nonfinite-policy", "warn"])
+        assert not cfg.probe_binarization
+        assert cfg.nonfinite_policy == "warn"
+        # defaults: probes on, fail fast
+        cfg = parse(["/data"])
+        assert cfg.probe_binarization
+        assert cfg.nonfinite_policy == "raise"
+
+
+class TestSummarizeSubcommand:
+    """The console entrypoint for post-hoc reports must not silently
+    break: run ``python -m bdbnn_tpu.cli summarize`` as a real
+    subprocess against a fixture run dir (built from files alone —
+    summarize never needs a live backend)."""
+
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "bdbnn_tpu.cli", "summarize", *argv],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    def test_summarize_report_and_json(self, fixture_run_dir):
+        proc = self._run(fixture_run_dir)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "== Run summary:" in proc.stdout
+        assert "compile" in proc.stdout
+        assert "starvation verdict:" in proc.stdout
+        assert "layer1_0.conv1" in proc.stdout
+
+        proc = self._run(fixture_run_dir, "--json")
+        assert proc.returncode == 0, proc.stderr[-800:]
+        summary = json.loads(proc.stdout)
+        assert summary["compile_s"] == pytest.approx(5.0)
+        assert summary["starvation"]["input_bound"] is True
+
+    def test_summarize_empty_dir_fails(self, tmp_path):
+        proc = self._run(str(tmp_path))
+        assert proc.returncode != 0

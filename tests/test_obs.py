@@ -1,0 +1,342 @@
+"""Unified telemetry tests (obs/): manifest round-trip, event-channel
+contents of a real synthetic fit(), the non-finite fail-fast policy,
+the summarize report engine, and the no-extra-syncs invariant (drain
+count at ``print_freq`` granularity is UNCHANGED by telemetry — the
+whole design rides the existing DeviceMetrics cadence)."""
+
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bdbnn_tpu.configs.config import RunConfig
+from bdbnn_tpu.obs import (
+    EventWriter,
+    RunManifest,
+    config_hash,
+    read_events,
+    read_manifest,
+    summarize_run,
+    write_manifest,
+)
+from bdbnn_tpu.obs.probes import NonFiniteLossError, drain_probe_report
+from bdbnn_tpu.train.loop import fit
+
+# the shared fit: 256 examples / batch 64 = 4 steps, print_freq 2
+STEPS = 4
+PRINT_FREQ = 2
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        dataset="cifar10",
+        synthetic=True,
+        synthetic_train_size=256,
+        synthetic_val_size=64,
+        arch="resnet20",
+        epochs=1,
+        batch_size=64,
+        lr=0.05,
+        print_freq=PRINT_FREQ,
+        log_path=str(tmp_path / "log"),
+        seed=0,
+        workers=2,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _find_run_dir(root):
+    hits = glob.glob(os.path.join(str(root), "**", "events.jsonl"),
+                     recursive=True)
+    assert hits, f"no events.jsonl under {root}"
+    return os.path.dirname(sorted(hits)[-1])
+
+
+@pytest.fixture(scope="module")
+def telemetry_run(tmp_path_factory):
+    """ONE 1-epoch synthetic fit, with DeviceMetrics.drain instrumented
+    to count real host syncs, shared by every assertion below."""
+    from bdbnn_tpu.utils.meters import DeviceMetrics
+
+    tmp = tmp_path_factory.mktemp("obsrun")
+    calls = {"drain": 0}
+    orig = DeviceMetrics.drain
+
+    def counted(self):
+        calls["drain"] += 1
+        return orig(self)
+
+    DeviceMetrics.drain = counted
+    try:
+        res = fit(_cfg(tmp))
+    finally:
+        DeviceMetrics.drain = orig
+    run_dir = _find_run_dir(tmp)
+    return {"res": res, "run_dir": run_dir, "drains": calls["drain"]}
+
+
+class TestManifest:
+    def test_write_read_roundtrip(self, tmp_path):
+        cfg = RunConfig(synthetic=True, epochs=3)
+        written = write_manifest(str(tmp_path), cfg)
+        loaded = read_manifest(str(tmp_path))
+        assert loaded == written
+        man = RunManifest.from_dict(loaded)
+        assert man.config_hash == written["config_hash"]
+        assert man.schema == 1
+        # provenance the summarize report keys on
+        for key in ("jax_version", "jaxlib_version", "backend",
+                    "device_count", "process_count", "config"):
+            assert loaded[key] is not None
+        assert loaded["config"]["epochs"] == 3
+
+    def test_missing_manifest_is_none(self, tmp_path):
+        assert read_manifest(str(tmp_path)) is None
+
+    def test_config_hash_stable_and_sensitive(self):
+        a = RunConfig(lr=0.1)
+        b = RunConfig(lr=0.1)
+        c = RunConfig(lr=0.2)
+        assert config_hash(a) == config_hash(b)
+        assert config_hash(a) != config_hash(c)
+
+
+class TestFitTelemetry:
+    def test_files_written(self, telemetry_run):
+        run_dir = telemetry_run["run_dir"]
+        assert os.path.exists(os.path.join(run_dir, "manifest.json"))
+        assert os.path.exists(os.path.join(run_dir, "events.jsonl"))
+        man = read_manifest(run_dir)
+        start = read_events(run_dir, "run_start")[0]
+        assert start["config_hash"] == man["config_hash"]
+        assert start["steps_per_epoch"] == STEPS
+
+    def test_event_kinds(self, telemetry_run):
+        kinds = {e["kind"] for e in read_events(telemetry_run["run_dir"])}
+        assert {"run_start", "compile", "train_interval", "epoch",
+                "eval", "run_end"} <= kinds
+
+    def test_step_phase_timing_fields(self, telemetry_run):
+        run_dir = telemetry_run["run_dir"]
+        intervals = read_events(run_dir, "train_interval")
+        assert intervals
+        for ev in intervals:
+            for key in ("data_wait_s", "dispatch_s", "drain_s",
+                        "interval_s", "data_wait_share", "steps",
+                        "loss", "grad_norm"):
+                assert key in ev, f"{key} missing from train_interval"
+            assert ev["data_wait_s"] >= 0 and ev["dispatch_s"] >= 0
+        compile_ev = read_events(run_dir, "compile")[0]
+        # first-step trace+compile is the big host block; sub-second
+        # would mean we timed a cached dispatch instead
+        assert compile_ev["seconds"] > 0.5
+        # compile is backed OUT of the first interval's phase wall —
+        # phase shares describe steady-state training, not compilation
+        assert intervals[0]["interval_s"] < compile_ev["seconds"]
+
+    def test_probe_fields(self, telemetry_run):
+        intervals = read_events(telemetry_run["run_dir"], "train_interval")
+        for ev in intervals:
+            assert ev.get("flip_rate") and ev.get("kurtosis")
+            for layer, rate in ev["flip_rate"].items():
+                assert 0.0 <= rate <= 1.0, (layer, rate)
+            for layer, k in ev["kurtosis"].items():
+                assert np.isfinite(k) and k > 0.0, (layer, k)
+        # the probed set is the non-stem convs of resnet20 (no kurtosis
+        # hooks in this run -> the "all" convention)
+        assert len(intervals[0]["flip_rate"]) == 20
+        # per-epoch probe scalars landed too (summarize's trajectory)
+        with open(os.path.join(telemetry_run["run_dir"],
+                               "scalars.jsonl")) as f:
+            tags = {json.loads(l)["tag"] for l in f if l.strip()}
+        assert any(t.startswith("Probe flip ") for t in tags)
+        assert any(t.startswith("Probe kurt ") for t in tags)
+
+    def test_no_extra_host_syncs(self, telemetry_run):
+        """THE invariant: telemetry must not add device syncs. Drains
+        stay at print_freq granularity — one per interval plus the
+        final partial — and every drain maps to exactly one
+        train_interval event."""
+        expected = len([i for i in range(STEPS) if i % PRINT_FREQ == 0])
+        if (STEPS - 1) % PRINT_FREQ != 0:
+            expected += 1  # trailing partial interval
+        assert telemetry_run["drains"] == expected
+        intervals = read_events(telemetry_run["run_dir"], "train_interval")
+        assert len(intervals) == expected
+
+    def test_summarize_real_run(self, telemetry_run):
+        report, summary = summarize_run(telemetry_run["run_dir"])
+        assert "compile" in report and "data-wait" in report
+        assert "starvation verdict:" in report
+        assert "layer1_0.conv1" in report
+        assert summary["compile_s"] > 0
+        assert summary["phases"]["interval_s"] > 0
+        assert summary["starvation"]["verdict"]
+        assert summary["best"]["acc1"] == pytest.approx(
+            telemetry_run["res"]["best_acc1"], abs=1e-2
+        )
+
+
+class TestNonFinitePolicy:
+    def test_injected_nan_fails_fast(self, tmp_path, monkeypatch):
+        """End-to-end: a NaN CE loss inside the jitted step must stop
+        the run at the next drain (policy 'raise', the default) — not
+        silently poison best-acc tracking."""
+        import bdbnn_tpu.train.step as step_mod
+
+        monkeypatch.setattr(
+            step_mod, "softmax_cross_entropy",
+            lambda logits, labels: jnp.float32(jnp.nan),
+        )
+        with pytest.raises(NonFiniteLossError, match="non-finite"):
+            fit(
+                _cfg(
+                    tmp_path,
+                    synthetic_train_size=128,
+                    probe_binarization=False,  # irrelevant here; compiles faster
+                )
+            )
+        # the incident is on the record for post-hoc diagnosis
+        nonfinite = read_events(_find_run_dir(tmp_path), "nonfinite")
+        assert nonfinite and nonfinite[0]["policy"] == "raise"
+
+    def test_eval_nan_loss_detected(self, tmp_path, monkeypatch):
+        """The eval-side signal is the LOSS (accuracy is a ratio of
+        boolean correct-counts — finite for any weights): a NaN
+        validation loss must trip the policy even when every train
+        interval was clean."""
+        import bdbnn_tpu.train.loop as loop_mod
+
+        orig = loop_mod.make_eval_step
+
+        def nan_eval(model, input_norm=None):
+            step = orig(model, input_norm=input_norm)
+
+            def wrapped(state, batch):
+                m = dict(step(state, batch))
+                m["loss_sum"] = m["loss_sum"] + jnp.float32(jnp.nan)
+                return m
+
+            return wrapped
+
+        monkeypatch.setattr(loop_mod, "make_eval_step", nan_eval)
+        with pytest.raises(NonFiniteLossError, match="validation loss"):
+            fit(_cfg(tmp_path, synthetic_train_size=64,
+                     probe_binarization=False))
+        ev = read_events(_find_run_dir(tmp_path), "nonfinite")
+        assert ev and ev[0]["where"] == "eval"
+
+    def test_policy_unit_semantics(self, tmp_path):
+        import logging
+
+        from bdbnn_tpu.train.loop import _apply_nonfinite_policy
+
+        logger = logging.getLogger("test_obs_nonfinite")
+        ev = EventWriter(str(tmp_path))
+        # warn: records + continues
+        _apply_nonfinite_policy("warn", logger, ev, "boom", epoch=0)
+        # ignore: records + continues (detection upstream is what the
+        # 'ignore' policy disables)
+        _apply_nonfinite_policy("ignore", logger, ev, "boom", epoch=1)
+        with pytest.raises(NonFiniteLossError):
+            _apply_nonfinite_policy("raise", logger, ev, "boom", epoch=2)
+        ev.close()
+        assert len(read_events(str(tmp_path), "nonfinite")) == 3
+
+    def test_ignore_policy_removes_detection(self):
+        cfg = RunConfig(synthetic=True, nonfinite_policy="ignore")
+        assert cfg.validate().nonfinite_policy == "ignore"
+        with pytest.raises(ValueError, match="nonfinite_policy"):
+            RunConfig(synthetic=True, nonfinite_policy="explode").validate()
+
+
+class TestEventChannel:
+    def test_nonfinite_values_serialize_as_null(self, tmp_path):
+        """events.jsonl must stay strict RFC-8259 JSON even when a
+        warn-policy run records NaN metrics: non-finite floats land as
+        null, never bare NaN/Infinity tokens (which jq and most
+        non-Python parsers reject)."""
+        ev = EventWriter(str(tmp_path))
+        ev.emit("train_interval", loss=float("nan"),
+                kurtosis={"a": float("inf")}, ok=1.5)
+        ev.close()
+        with open(ev.path) as f:
+            line = f.read().strip()
+
+        def no_constants(s):
+            raise AssertionError(f"bare {s} token in events.jsonl")
+
+        rec = json.loads(line, parse_constant=no_constants)
+        assert rec["loss"] is None
+        assert rec["kurtosis"]["a"] is None
+        assert rec["ok"] == 1.5
+
+
+class TestProbeMath:
+    def test_drain_probe_report_normalization(self):
+        sums = {"flips/a": 30.0, "kurt/a": 7.5}
+        flip, kurt = drain_probe_report(sums, {"a": 100}, 3)
+        # 30 flips over 3 steps of a 100-weight layer = 0.1/step
+        assert flip["a"] == pytest.approx(0.1)
+        assert kurt["a"] == pytest.approx(2.5)
+
+
+class TestSummarizeFixture:
+    def test_report(self, fixture_run_dir):
+        report, summary = summarize_run(fixture_run_dir)
+        assert "compile: first-step trace+compile 5.00s" in report
+        # fixture phase timing is half data-wait -> input-bound verdict
+        assert summary["starvation"]["input_bound"] is True
+        assert "INPUT-BOUND" in report
+        assert "layer1_0.conv1" in report
+        assert summary["best"]["acc1"] == pytest.approx(90.0)
+        # flip rate decays across the fixture's epochs
+        probes = summary["probes"]["layer1_0.conv1"]
+        assert probes["flip_rate_first"] > probes["flip_rate_last"]
+        assert summary["loss_components"]["loss_ce"][0] > (
+            summary["loss_components"]["loss_ce"][-1]
+        )
+
+    def test_probe_fallback_is_chronological(self, fixture_run_dir):
+        """Without scalars.jsonl the probe trajectories come from the
+        per-interval events, whose `step` field resets each epoch —
+        first/last must still be chronological (keyed on epoch+step)."""
+        os.remove(os.path.join(fixture_run_dir, "scalars.jsonl"))
+        _, summary = summarize_run(fixture_run_dir)
+        probes = summary["probes"]["layer1_0.conv1"]
+        # the fixture decays flip rate per epoch: 1e-3 -> 1e-3/3
+        assert probes["flip_rate_first"] == pytest.approx(1e-3)
+        assert probes["flip_rate_last"] == pytest.approx(1e-3 / 3, abs=1e-6)
+
+    def test_probe_fallback_skips_null_values(self, fixture_run_dir):
+        """A warn-policy run's NaN kurtosis lands as null in the event
+        (jsonsafe); the fallback must skip it, not crash the report of
+        exactly the broken run being post-mortemed."""
+        os.remove(os.path.join(fixture_run_dir, "scalars.jsonl"))
+        path = os.path.join(fixture_run_dir, "events.jsonl")
+        with open(path) as f:
+            lines = f.readlines()
+        with open(path, "w") as f:
+            for line in lines:
+                rec = json.loads(line)
+                if rec.get("kind") == "train_interval":
+                    rec["kurtosis"] = {"layer1_0.conv1": None}
+                f.write(json.dumps(rec) + "\n")
+        report, summary = summarize_run(fixture_run_dir)
+        probes = summary["probes"]["layer1_0.conv1"]
+        assert "flip_rate_first" in probes
+        assert "kurtosis_first" not in probes  # all nulls -> no curve
+        assert "layer1_0.conv1" in report
+
+    def test_resolves_from_log_root(self, fixture_run_dir):
+        root = os.path.dirname(fixture_run_dir)
+        _, summary = summarize_run(root)
+        assert summary["run_dir"] == fixture_run_dir
+
+    def test_missing_dir_is_hard_error(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            summarize_run(str(tmp_path / "empty"))
