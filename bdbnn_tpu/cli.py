@@ -131,6 +131,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a jax.profiler trace of a few epoch-0 steps here",
     )
     p.add_argument(
+        "--profile-at", action="append", default=[],
+        metavar="EPOCH:STEP[:NSTEPS]",
+        help="capture a jax.profiler trace window at an arbitrary "
+        "point (repeatable), e.g. 12:40:8 = 8 steps from epoch 12 "
+        "step 40; traces land under --profile-dir if set, else "
+        "<run_dir>/profile, where `summarize` picks them up for "
+        "per-category device-time attribution",
+    )
+    p.add_argument(
         "--no-binarization-probes", dest="probe_binarization",
         action="store_false",
         help="disable the on-device per-layer sign-flip/kurtosis "
@@ -226,6 +235,7 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         input_backend=args.input_backend,
         target_acc=args.target_acc,
         profile_dir=args.profile_dir,
+        profile_at=tuple(args.profile_at),
         probe_binarization=args.probe_binarization,
         nonfinite_policy=args.nonfinite_policy,
     )
@@ -256,13 +266,45 @@ def summarize_main(argv) -> int:
     return 0
 
 
+def watch_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli watch RUN_DIR [--interval S] [--once]``
+    — live-tail a run's ``events.jsonl`` (current epoch, last eval
+    acc, flip-rate drift, starvation flag). Reads files only; never
+    initializes a JAX backend, so it can watch a pod run from a
+    laptop's synced log dir."""
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli watch",
+        description="Live status of a run directory (or a log root "
+        "above it; the newest run wins). Ctrl-C to stop.",
+    )
+    ap.add_argument("run_dir", help="run directory (or log root)")
+    ap.add_argument(
+        "--interval", type=float, default=2.0,
+        help="poll period in seconds (default 2)",
+    )
+    ap.add_argument(
+        "--once", action="store_true",
+        help="print the current status once and exit",
+    )
+    args = ap.parse_args(argv)
+
+    from bdbnn_tpu.obs.summarize import resolve_run_dir
+    from bdbnn_tpu.obs.watch import watch_run
+
+    run_dir = resolve_run_dir(args.run_dir)
+    return watch_run(run_dir, interval=args.interval, once=args.once)
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     # subcommand dispatch ahead of the reference-compatible flag surface
-    # (a dataset dir named "summarize" would shadow it — none does)
+    # (a dataset dir named "summarize"/"watch" would shadow it — none
+    # does)
     if argv and argv[0] == "summarize":
         return summarize_main(argv[1:])
+    if argv and argv[0] == "watch":
+        return watch_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
 

@@ -118,6 +118,12 @@ class RunConfig:
     profile_dir: str = ""
     profile_start: int = 5
     profile_steps: int = 5
+    # capture windows at ARBITRARY points: "EPOCH:STEP[:NSTEPS]" specs
+    # (repeatable --profile-at). Generalizes the epoch-0-only
+    # profile_dir window; traces land under profile_dir when set, else
+    # <run_dir>/profile — where `summarize` finds them for the
+    # semantic attribution section (obs/trace.py).
+    profile_at: Tuple[str, ...] = ()
     # unified telemetry (obs/): fit() always writes manifest.json +
     # events.jsonl. The on-device binarization probes (per-hooked-layer
     # sign-flip rate + weight kurtosis, obs/probes.py) default ON for
@@ -156,6 +162,12 @@ class RunConfig:
                 f"unknown nonfinite_policy {self.nonfinite_policy!r} "
                 "(raise | warn | ignore)"
             )
+        if self.profile_at:
+            # fail at config time, not at the target epoch hours in
+            from bdbnn_tpu.obs.trace import parse_profile_at
+
+            for spec in self.profile_at:
+                parse_profile_at(spec, default_steps=self.profile_steps)
         if not 0.0 <= self.target_acc < 100.0:
             raise ValueError(
                 f"target_acc is a top-1 PERCENTAGE in [0, 100), got "

@@ -38,10 +38,11 @@ def distribution_loss(stud_logits: Array, teacher_logits: Array) -> Array:
     The teacher side is stop_gradient'ed, replacing the reference's
     runtime ``requires_grad`` assertion (``utils/KD_loss.py:22-23``).
     """
-    teacher_logits = jax.lax.stop_gradient(teacher_logits)
-    logp_s = jax.nn.log_softmax(stud_logits, axis=1)
-    p_t = jax.nn.softmax(teacher_logits, axis=1)
-    return jnp.mean(-jnp.sum(p_t * logp_s, axis=1))
+    with jax.named_scope("kd_logit_loss"):
+        teacher_logits = jax.lax.stop_gradient(teacher_logits)
+        logp_s = jax.nn.log_softmax(stud_logits, axis=1)
+        p_t = jax.nn.softmax(teacher_logits, axis=1)
+        return jnp.mean(-jnp.sum(p_t * logp_s, axis=1))
 
 
 def _kl_div_log_target_mean(input_: Array, log_target: Array) -> Array:
@@ -58,11 +59,12 @@ def layer_weight_kl(
     ``DistributionLoss_layer``, ``utils/KD_loss.py:46-67``): for each
     pair, KLDivLoss(log_target=True) on the raw weight tensors, with
     student as input and teacher as (log-)target."""
-    total = jnp.float32(0.0)
-    for ws, wt in zip(stud_weights, teacher_weights, strict=True):
-        wt = jax.lax.stop_gradient(wt)
-        total = total + _kl_div_log_target_mean(ws, wt)
-    return total
+    with jax.named_scope("kd_weight_loss"):
+        total = jnp.float32(0.0)
+        for ws, wt in zip(stud_weights, teacher_weights, strict=True):
+            wt = jax.lax.stop_gradient(wt)
+            total = total + _kl_div_log_target_mean(ws, wt)
+        return total
 
 
 def layer_weight_kl_softened(

@@ -97,13 +97,19 @@ def kurtosis_regularization(
         raise ValueError(
             f"{len(weights)} weight tensors but {len(targets)} targets"
         )
-    losses = jnp.stack([kurtosis_loss(w, t) for w, t in zip(weights, targets)])
-    if mode == "sum":
-        return jnp.sum(losses)
-    if mode == "avg":
-        return jnp.mean(losses)
-    if mode == "max":
-        return jnp.max(losses)
+    # "kurtosis_loss" named scope: the regularizer's ops (and their
+    # gradients, which inherit the scope path) attribute as one device
+    # trace category (obs/trace.py DEVICE_SPANS)
+    with jax.named_scope("kurtosis_loss"):
+        losses = jnp.stack(
+            [kurtosis_loss(w, t) for w, t in zip(weights, targets)]
+        )
+        if mode == "sum":
+            return jnp.sum(losses)
+        if mode == "avg":
+            return jnp.mean(losses)
+        if mode == "max":
+            return jnp.max(losses)
     raise ValueError(f"unknown kurtosis mode: {mode!r}")
 
 

@@ -146,28 +146,35 @@ class BiBasicBlock(nn.Module):
                 strides=(1, 1),
                 name="downsample_conv",
             )(pooled, tk=tk)
-            shortcut = _batch_norm(train, "downsample_bn", self.dtype)(shortcut)
+            with jax.named_scope("bn_act"):
+                shortcut = _batch_norm(
+                    train, "downsample_bn", self.dtype
+                )(shortcut)
         else:
             shortcut = x
 
-        # -- unit 1
+        # -- unit 1 ("bn_act" named scopes: BN + residual add +
+        # activation attribute as one semantic category in device
+        # traces, obs/trace.py DEVICE_SPANS)
         y = conv_cls(
             self.features,
             kernel_size=(3, 3),
             strides=(self.strides, self.strides),
             name="conv1",
         )(x, tk=tk)
-        y = _batch_norm(train, "bn1", self.dtype)(y)
-        y = y + shortcut
-        y = _activation(self.act, "act1")(y)
+        with jax.named_scope("bn_act"):
+            y = _batch_norm(train, "bn1", self.dtype)(y)
+            y = y + shortcut
+            y = _activation(self.act, "act1")(y)
 
         # -- unit 2 (identity shortcut)
         z = conv_cls(
             self.features, kernel_size=(3, 3), strides=(1, 1), name="conv2"
         )(y, tk=tk)
-        z = _batch_norm(train, "bn2", self.dtype)(z)
-        z = z + y
-        z = _activation(self.act, "act2")(z)
+        with jax.named_scope("bn_act"):
+            z = _batch_norm(train, "bn2", self.dtype)(z)
+            z = z + y
+            z = _activation(self.act, "act2")(z)
         return z
 
     def _float_forward(self, x: Array, *, train: bool) -> Array:

@@ -110,16 +110,23 @@ class _BinaryConvBase(nn.Module):
         :func:`bdbnn_tpu.nn.kernels.binary_conv2d_mxu` — the stock XLA
         conv on ±1 operands (the measured winner; the int8/Pallas
         candidates were deleted with data, see the decision record in
-        nn/kernels/binary_conv.py)."""
-        w = self.latent_weight(in_features).astype(xb.dtype)
-        signed = ste_sign(w)
-        reduce_axes = tuple(range(w.ndim - 1))
-        alpha = jax.lax.stop_gradient(
-            jnp.mean(jnp.abs(w), axis=reduce_axes)
-        )
-        return binary_conv2d_mxu(
-            xb, signed, alpha, strides=self.strides, padding=self.padding
-        )
+        nn/kernels/binary_conv.py).
+
+        The ``binarize`` / ``binary_conv`` named scopes land in XLA op
+        metadata so device trace events attribute to stable semantic
+        categories (obs/trace.py DEVICE_SPANS) instead of fusion names.
+        """
+        with jax.named_scope("binarize"):
+            w = self.latent_weight(in_features).astype(xb.dtype)
+            signed = ste_sign(w)
+            reduce_axes = tuple(range(w.ndim - 1))
+            alpha = jax.lax.stop_gradient(
+                jnp.mean(jnp.abs(w), axis=reduce_axes)
+            )
+        with jax.named_scope("binary_conv"):
+            return binary_conv2d_mxu(
+                xb, signed, alpha, strides=self.strides, padding=self.padding
+            )
 
 
 class BinaryConvReact(_BinaryConvBase):
@@ -133,7 +140,8 @@ class BinaryConvReact(_BinaryConvBase):
         shift = self.param(
             "act_shift", nn.initializers.zeros, (x.shape[-1],)
         )
-        xb = approx_sign(x - shift.astype(x.dtype))
+        with jax.named_scope("binarize"):
+            xb = approx_sign(x - shift.astype(x.dtype))
         return self.binary_conv(xb, x.shape[-1])
 
 
@@ -143,7 +151,8 @@ class BinaryConv(_BinaryConvBase):
 
     @nn.compact
     def __call__(self, x: Array, tk=None) -> Array:
-        xb = binarize_act(x, estimator="ste", tk=tk)
+        with jax.named_scope("binarize"):
+            xb = binarize_act(x, estimator="ste", tk=tk)
         return self.binary_conv(xb, x.shape[-1])
 
 
@@ -154,7 +163,8 @@ class BinaryConvCifar(_BinaryConvBase):
 
     @nn.compact
     def __call__(self, x: Array, tk=None) -> Array:
-        xb = binarize_act(x, estimator="ste", tk=tk)
+        with jax.named_scope("binarize"):
+            xb = binarize_act(x, estimator="ste", tk=tk)
         return self.binary_conv(xb, x.shape[-1])
 
 

@@ -7,13 +7,21 @@ traces):
 - :mod:`bdbnn_tpu.obs.manifest` — ``manifest.json`` provenance
 - :mod:`bdbnn_tpu.obs.events`   — ``events.jsonl`` structured timeline
 - :mod:`bdbnn_tpu.obs.timing`   — host step-phase accounting
+- :mod:`bdbnn_tpu.obs.trace`    — semantic span taxonomy, the trace
+  parser (per-category device ms/step + MFU), and exception-safe
+  capture windows (``--profile-at``)
+- :mod:`bdbnn_tpu.obs.memory`   — HBM watermark polling (``memory``
+  events)
 - :mod:`bdbnn_tpu.obs.probes`   — on-device binarization health probes
   (imported lazily by the train step; it needs jax)
 - :mod:`bdbnn_tpu.obs.summarize` — the ``summarize`` CLI's report engine
+- :mod:`bdbnn_tpu.obs.watch`    — the ``watch`` CLI's live status tail
 
-This package root stays stdlib-importable: ``summarize`` must read run
-directories without initializing a JAX backend, so anything needing jax
-lives in :mod:`~bdbnn_tpu.obs.probes` and is NOT imported here.
+This package root stays stdlib-importable: ``summarize``/``watch`` must
+read run directories without initializing a JAX backend, so anything
+needing jax lives in :mod:`~bdbnn_tpu.obs.probes` (or behind the lazy
+imports inside :class:`~bdbnn_tpu.obs.trace.TraceCapture`) and is NOT
+imported here.
 """
 
 from __future__ import annotations
@@ -21,7 +29,12 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
-from bdbnn_tpu.obs.events import EVENTS_NAME, EventWriter, read_events
+from bdbnn_tpu.obs.events import (
+    EVENTS_NAME,
+    KNOWN_KINDS,
+    EventWriter,
+    read_events,
+)
 from bdbnn_tpu.obs.manifest import (
     MANIFEST_NAME,
     RunManifest,
@@ -29,8 +42,20 @@ from bdbnn_tpu.obs.manifest import (
     read_manifest,
     write_manifest,
 )
+from bdbnn_tpu.obs.memory import emit_memory_event, hbm_watermark
 from bdbnn_tpu.obs.summarize import resolve_run_dir, summarize_run
 from bdbnn_tpu.obs.timing import StepPhaseTimer
+from bdbnn_tpu.obs.trace import (
+    BF16_PEAK_TFLOPS,
+    DEVICE_SPANS,
+    HOST_PHASES,
+    TraceCapture,
+    attribute_trace,
+    find_trace_file,
+    hlo_breakdown,
+    jit_step_ms,
+    parse_profile_at,
+)
 
 
 @dataclasses.dataclass
@@ -42,16 +67,30 @@ class ObsHooks:
     # layer name -> weight count, for normalizing drained flip sums
     probe_sizes: Dict[str, int]
     nonfinite_policy: str = "raise"
+    # --profile-at capture windows (None = no windows requested)
+    tracer: Optional[TraceCapture] = None
 
 
 __all__ = [
+    "BF16_PEAK_TFLOPS",
+    "DEVICE_SPANS",
     "EVENTS_NAME",
+    "HOST_PHASES",
+    "KNOWN_KINDS",
     "MANIFEST_NAME",
     "EventWriter",
     "ObsHooks",
     "RunManifest",
     "StepPhaseTimer",
+    "TraceCapture",
+    "attribute_trace",
     "config_hash",
+    "emit_memory_event",
+    "find_trace_file",
+    "hbm_watermark",
+    "hlo_breakdown",
+    "jit_step_ms",
+    "parse_profile_at",
     "read_events",
     "read_manifest",
     "resolve_run_dir",

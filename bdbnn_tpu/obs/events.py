@@ -18,9 +18,18 @@ Event kinds emitted by ``fit()``:
 - ``epoch``       — epoch train means + wall seconds
 - ``eval``        — per-validation acc1/acc5/loss
 - ``nonfinite``   — a drained interval contained non-finite losses
+- ``profile``     — a trace capture window closed (epoch, start_step,
+  steps, trace_dir) — `summarize` keys its attribution section on it
+- ``memory``      — HBM watermark poll (obs/memory.py)
 - ``run_end``     — best acc/epoch, total wall seconds
 
 ``bench.py`` adds ``bench_result`` records with the same envelope.
+
+New kinds must be registered in :data:`KNOWN_KINDS` —
+``tests/test_events_schema.py`` AST-scans every ``.emit(`` call site in
+the package against it, and round-trips each kind's payload through a
+strict RFC-8259 parser, so an unregistered kind (or one smuggling NaN)
+fails CI instead of silently corrupting the channel.
 """
 
 from __future__ import annotations
@@ -33,18 +42,51 @@ from typing import Any, Dict, List, Optional
 
 EVENTS_NAME = "events.jsonl"
 
+# every event kind any EventWriter.emit call site may use
+KNOWN_KINDS = frozenset(
+    {
+        "run_start",
+        "compile",
+        "train_interval",
+        "epoch",
+        "eval",
+        "nonfinite",
+        "profile",
+        "memory",
+        "run_end",
+        "bench_result",
+    }
+)
+
 
 def jsonsafe(obj: Any) -> Any:
-    """Recursively replace non-finite floats with None: bare ``NaN``
-    tokens are invalid RFC-8259 JSON (jq and most non-Python consumers
-    reject the whole line), and the ``nonfinite`` event kind already
-    carries the incident explicitly."""
+    """Recursively coerce a payload to strict RFC-8259 values.
+
+    Non-finite floats become None: bare ``NaN`` tokens are invalid JSON
+    (jq and most non-Python consumers reject the whole line), and the
+    ``nonfinite`` event kind already carries the incident explicitly.
+    Non-builtin numeric scalars (``np.float32``/``np.int64``/0-d arrays
+    — anything with ``.item()``) are unwrapped to Python numbers:
+    ``json.dumps`` would otherwise bounce them to ``default=repr``
+    strings. No numpy import — obs stays stdlib."""
+    if isinstance(obj, bool):
+        return obj
     if isinstance(obj, float):
         return obj if math.isfinite(obj) else None
+    if isinstance(obj, (int, str, type(None))):
+        return obj
     if isinstance(obj, dict):
         return {k: jsonsafe(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [jsonsafe(v) for v in obj]
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            unwrapped = item()
+        except Exception:
+            return obj
+        if type(unwrapped) is not type(obj):  # guard: item() must unwrap
+            return jsonsafe(unwrapped)
     return obj
 
 
@@ -100,3 +142,13 @@ def read_events(
     if kind is None:
         return recs
     return [r for r in recs if r.get("kind") == kind]
+
+
+__all__ = [
+    "EVENTS_NAME",
+    "KNOWN_KINDS",
+    "EventWriter",
+    "jsonsafe",
+    "read_events",
+    "read_jsonl",
+]

@@ -1,0 +1,105 @@
+"""HBM watermark telemetry.
+
+``device.memory_stats()`` (PJRT allocator counters: ``bytes_in_use``,
+``peak_bytes_in_use``, ``bytes_limit``, ...) polled at cheap moments —
+after the first-step compile and at epoch boundaries — and emitted as
+``memory`` events into the run's ``events.jsonl``. That turns "did this
+config fit, and how close to the HBM ceiling did it sail?" into a
+post-hoc file question (`summarize` renders peak/limit), instead of a
+rerun-under-a-profiler question.
+
+Stdlib-only by the obs-package rule: devices are PASSED IN (the train
+loop hands over ``jax.local_devices()``); nothing here imports jax.
+Backends without allocator stats (CPU returns ``None``) emit the event
+with ``available: false`` so the schema — and the tooling reading it —
+is identical everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+# normalized per-device fields, in emit order
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_stats(device) -> Optional[Dict[str, int]]:
+    """One device's allocator counters, normalized to the three fields
+    every consumer needs — or None when the backend has none."""
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out: Dict[str, int] = {}
+    for key in _STAT_KEYS:
+        v = stats.get(key)
+        if v is not None:
+            out[key] = int(v)
+    # a backend reporting usage but no high-water mark still yields a
+    # usable watermark: the poll-time usage is a lower bound
+    if "peak_bytes_in_use" not in out and "bytes_in_use" in out:
+        out["peak_bytes_in_use"] = out["bytes_in_use"]
+    return out or None
+
+
+def hbm_snapshot(devices: Sequence[Any]) -> List[Dict[str, Any]]:
+    """Per-device stat rows for every local device that reports them."""
+    rows = []
+    for d in devices:
+        stats = device_memory_stats(d)
+        if stats is None:
+            continue
+        rows.append({"device": str(getattr(d, "id", d)), **stats})
+    return rows
+
+
+def emit_memory_event(events, phase: str, devices: Sequence[Any], **fields):
+    """Poll ``devices`` and append one ``memory`` event.
+
+    Schema: ``{kind: "memory", phase: "post_compile"|"epoch",
+    available: bool, devices: [...], peak_bytes, limit_bytes, ...}``.
+    ``peak_bytes``/``limit_bytes`` are the max over local devices (the
+    binding constraint under data parallelism — every chip holds the
+    same replicated state). Never raises past telemetry: a failing
+    allocator query must not kill a training run."""
+    try:
+        rows = hbm_snapshot(devices)
+    except Exception:
+        rows = []
+    peaks = [r["peak_bytes_in_use"] for r in rows if "peak_bytes_in_use" in r]
+    limits = [r["bytes_limit"] for r in rows if "bytes_limit" in r]
+    return events.emit(
+        "memory",
+        phase=phase,
+        available=bool(rows),
+        devices=rows,
+        peak_bytes=max(peaks) if peaks else None,
+        limit_bytes=max(limits) if limits else None,
+        **fields,
+    )
+
+
+def hbm_watermark(memory_events: Sequence[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Fold a run's ``memory`` events into the summary's HBM section:
+    the run-wide peak, the device limit, and their ratio."""
+    peaks = [
+        e["peak_bytes"] for e in memory_events if e.get("peak_bytes")
+    ]
+    limits = [
+        e["limit_bytes"] for e in memory_events if e.get("limit_bytes")
+    ]
+    if not peaks:
+        return None
+    peak = max(peaks)
+    limit = max(limits) if limits else None
+    out: Dict[str, Any] = {
+        "peak_bytes": peak,
+        "peak_gib": round(peak / 2**30, 3),
+        "limit_bytes": limit,
+    }
+    if limit:
+        out["limit_gib"] = round(limit / 2**30, 3)
+        out["utilization"] = round(peak / limit, 4)
+    return out

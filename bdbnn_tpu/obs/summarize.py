@@ -11,6 +11,11 @@ debugging a finished BNN run actually asks:
 - did the latent weights actually go bimodal? (per-layer kurtosis)
 - did binarized weights churn? (per-layer sign-flip rates)
 - how long to each accuracy level, and what did each loss term do?
+- where did device time actually go, and how close to the HBM ceiling?
+  (the "attribution" section — rendered whenever the run captured a
+  ``--profile-at`` trace window and/or ``memory`` events: per-semantic-
+  category device ms/step from the span-annotated trace, an MFU
+  estimate, and the run-wide HBM peak against the device limit)
 
 Stdlib-only: summarizing a run must never initialize a JAX backend.
 """
@@ -23,6 +28,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from bdbnn_tpu.obs.events import jsonsafe, read_events, read_jsonl
 from bdbnn_tpu.obs.manifest import read_manifest
+from bdbnn_tpu.obs.memory import hbm_watermark
+from bdbnn_tpu.obs.trace import (
+    BF16_PEAK_TFLOPS,
+    attribute_trace,
+    find_trace_file,
+)
 
 # data-wait share of interval wall time above which a run is called
 # input-bound: at 35% the host spends over a third of each interval
@@ -169,6 +180,53 @@ def _probe_trajectories(scalars, events) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def _attribution(run_dir, manifest, events) -> Optional[Dict[str, Any]]:
+    """The device-time + HBM section, present whenever the run captured
+    a trace window (``profile`` event) or memory watermarks (``memory``
+    events).
+
+    Per-category ms/step comes from parsing the newest trace file under
+    the run dir with the semantic-span parser; MFU pairs the trace's
+    step total with the profile event's FLOPs (when recorded) or the
+    trace's own per-op flops metadata, against the manifest device
+    kind's published bf16 peak."""
+    profile_evs = [e for e in events if e.get("kind") == "profile"]
+    memory_evs = [e for e in events if e.get("kind") == "memory"]
+    if not profile_evs and not memory_evs:
+        return None
+    out: Dict[str, Any] = {}
+    if profile_evs:
+        pe = profile_evs[-1]
+        out["captured"] = {
+            k: pe.get(k) for k in ("epoch", "start_step", "steps")
+        }
+        peak = None
+        if manifest:
+            peak = BF16_PEAK_TFLOPS.get(manifest.get("device_kind", ""))
+        trace_path = None
+        # the trace lives under the run dir (--profile-at default) or
+        # wherever the profile event says the window was written
+        for root in (run_dir, pe.get("trace_dir") or ""):
+            if root and os.path.isdir(root):
+                trace_path = find_trace_file(root)
+                if trace_path:
+                    break
+        if trace_path:
+            att = attribute_trace(
+                trace_path,
+                pe.get("steps") or 1,
+                flops_per_step=pe.get("flops_per_step"),
+                peak_tflops=peak,
+            )
+            out.update(att)
+            out["trace_file"] = trace_path
+        else:
+            out["trace_file"] = None
+    if memory_evs:
+        out["hbm"] = hbm_watermark(memory_evs)
+    return out
+
+
 def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     """Returns ``(report_text, summary_dict)`` for a run directory."""
     run_dir = resolve_run_dir(path)
@@ -217,6 +275,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     }
 
     probes = _probe_trajectories(scalars, events)
+    attribution = _attribution(run_dir, manifest, events)
 
     summary: Dict[str, Any] = {
         "run_dir": run_dir,
@@ -241,6 +300,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
         "best": best,
         "loss_components": components,
         "probes": probes,
+        "attribution": attribution,
         "nonfinite_intervals": len(nonfinite),
     }
     # strict JSON out the other end too: a warn-policy run's NaN
@@ -298,6 +358,42 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                 f"  {name:<12} {vals[0]:.5g} -> {vals[-1]:.5g} "
                 f"({len(vals)} epochs)"
             )
+    if attribution:
+        cats = attribution.get("categories_ms_per_step") or {}
+        if cats:
+            cap = attribution.get("captured") or {}
+            lines.append(
+                "device attribution (ms/step over "
+                f"{attribution.get('n_steps')} traced steps @ epoch "
+                f"{cap.get('epoch')} step {cap.get('start_step')}):"
+            )
+            total = attribution.get("step_total_ms")
+            for name, ms in cats.items():
+                share = f" ({ms / total:.0%})" if total else ""
+                lines.append(f"  {name:<16} {ms:8.3f} ms{share}")
+            if total:
+                lines.append(f"  {'step total':<16} {total:8.3f} ms")
+            if attribution.get("mfu") is not None:
+                lines.append(
+                    f"  MFU {attribution['mfu']:.1%} of "
+                    f"{attribution.get('peak_tflops')} TFLOP/s bf16 peak"
+                )
+        host = attribution.get("host_phases_ms_per_step") or {}
+        if host:
+            lines.append(
+                "host phases in window: "
+                + "  ".join(f"{k} {v:.3f} ms" for k, v in host.items())
+            )
+        hbm = attribution.get("hbm")
+        if hbm:
+            if hbm.get("limit_gib"):
+                lines.append(
+                    f"hbm: peak {hbm['peak_gib']:.2f} GiB of "
+                    f"{hbm['limit_gib']:.2f} GiB "
+                    f"({hbm['utilization']:.0%})"
+                )
+            else:
+                lines.append(f"hbm: peak {hbm['peak_gib']:.2f} GiB")
     if probes:
         lines.append(
             "binarization probes (per-layer, first -> last interval/epoch):"

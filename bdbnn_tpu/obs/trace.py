@@ -1,0 +1,457 @@
+"""Semantic trace attribution — spans, capture windows, and the parser.
+
+The paper's cost story (XNOR-Net lineage) is that binary convs should
+dominate neither time nor memory; checking that used to mean regexing
+raw HLO op names out of a ``jax.profiler`` trace with a one-off script
+(``profile_r05.py``) that only understood the flagship bench config.
+This module makes the attribution first-class, in three parts:
+
+1. **Span taxonomy.** The jitted step's meaningful segments are wrapped
+   in ``jax.named_scope`` at their definition sites (``nn/layers.py``,
+   ``nn/binarize.py``, ``models/resnet.py``, ``losses/``,
+   ``train/step.py``), so XLA op metadata — and therefore device trace
+   events — carry stable category names (:data:`DEVICE_SPANS`) instead
+   of fusion-renamed HLO suffixes. Host phases (:data:`HOST_PHASES`)
+   are annotated by the train loop with
+   ``jax.profiler.TraceAnnotation`` while a capture window is open.
+
+2. **Parser** (:func:`attribute_trace`, :func:`hlo_breakdown`,
+   :func:`jit_step_ms`) — stdlib-only aggregation of a
+   ``trace.json.gz`` into per-category device ms/step + an MFU
+   estimate, for ANY config. ``summarize`` (which must never
+   initialize a JAX backend) and the bench/profile harnesses share it.
+
+3. **Capture windows** (:class:`TraceCapture`) — start/stop the
+   profiler at arbitrary ``EPOCH:STEP[:NSTEPS]`` points
+   (``--profile-at``), exception-safe: a step that raises between
+   start and stop can neither leave the profiler running nor stop it
+   twice. ``jax`` is imported lazily inside the capture methods only.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from contextlib import nullcontext
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+# Device-side span taxonomy: the categories a BD-BNN train step's
+# device time decomposes into. Each name is a jax.named_scope at the
+# site that owns the math; trace events are attributed to the INNERMOST
+# matching span on their metadata path.
+DEVICE_SPANS: Tuple[str, ...] = (
+    "binarize",       # sign/STE of weights + activations (nn/layers.py)
+    "binary_conv",    # the ±alpha conv itself (nn/kernels)
+    "bn_act",         # BatchNorm + residual add + activation (models/resnet.py)
+    "kurtosis_loss",  # the bimodal regularizer (losses/kurtosis.py)
+    "kd_logit_loss",  # KD distribution loss over logits (losses/kd.py)
+    "kd_weight_loss", # KD layer weight KL (losses/kd.py)
+    "ede_grad",       # EDE estimator backward transform (nn/binarize.py)
+    "optimizer",      # optax update + apply (train/step.py)
+    "probes",         # binarization health probes (obs/probes.py)
+)
+
+# Host-side phases, annotated by the train loop while a window is open.
+HOST_PHASES: Tuple[str, ...] = ("data_wait", "dispatch")
+
+# Published per-chip dense bf16 peaks (TFLOP/s), keyed on
+# jax.devices()[0].device_kind. Sources: Google Cloud TPU system
+# architecture docs (v2-v6e product pages). Shared by bench.py,
+# profile_r05.py and `summarize`'s MFU estimate.
+BF16_PEAK_TFLOPS: Dict[str, float] = {
+    "TPU v2": 22.5,
+    "TPU v3": 61.5,
+    "TPU v4": 275.0,  # one megacore device per chip
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,       # v5p reports device_kind "TPU v5"
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,  # v6e (Trillium)
+    "TPU v6e": 918.0,
+}
+
+TraceSource = Union[str, Sequence[Dict[str, Any]]]
+
+
+# ---------------------------------------------------------------------------
+# capture-window spec
+# ---------------------------------------------------------------------------
+
+
+def parse_profile_at(spec: str, default_steps: int = 5) -> Tuple[int, int, int]:
+    """``"EPOCH:STEP[:NSTEPS]"`` → ``(epoch, start_step, n_steps)``.
+
+    Generalizes the legacy epoch-0-only ``--profile-dir`` window to an
+    arbitrary point in training (e.g. ``12:40:8`` = 8 steps starting at
+    epoch 12 step 40 — after the kurtosis gate opens, say)."""
+    parts = spec.split(":")
+    if len(parts) not in (2, 3):
+        raise ValueError(
+            f"bad --profile-at spec {spec!r}: want EPOCH:STEP[:NSTEPS]"
+        )
+    try:
+        nums = [int(p) for p in parts]
+    except ValueError as e:
+        raise ValueError(f"bad --profile-at spec {spec!r}: {e}") from None
+    epoch, step = nums[0], nums[1]
+    n_steps = nums[2] if len(nums) == 3 else default_steps
+    if epoch < 0 or step < 0 or n_steps < 1:
+        raise ValueError(
+            f"bad --profile-at spec {spec!r}: epoch/step must be >= 0 "
+            "and NSTEPS >= 1"
+        )
+    return epoch, step, n_steps
+
+
+# ---------------------------------------------------------------------------
+# trace parsing (stdlib-only; shared by summarize / bench / profile_r05)
+# ---------------------------------------------------------------------------
+
+
+def find_trace_file(root: str) -> Optional[str]:
+    """Newest ``*.trace.json.gz`` under ``root`` (the profiler writes
+    ``<dir>/plugins/profile/<ts>/<host>.trace.json.gz``)."""
+    hits = sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.json.gz"), recursive=True)
+    )
+    return hits[-1] if hits else None
+
+
+def load_trace_events(source: TraceSource) -> List[Dict[str, Any]]:
+    """Trace events from a path (``.json.gz`` or plain ``.json``) or an
+    already-loaded event list (passthrough)."""
+    if not isinstance(source, str):
+        return list(source)
+    opener = gzip.open if source.endswith(".gz") else open
+    with opener(source, "rt") as f:
+        tr = json.load(f)
+    return tr.get("traceEvents", [])
+
+
+def _pid_names(events) -> Dict[Any, str]:
+    return {
+        e["pid"]: str(e.get("args", {}).get("name", ""))
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+
+
+def _real_device_pids(events) -> set:
+    """Pids of true device tracks (TPU/GPU processes)."""
+    names = _pid_names(events)
+    return {
+        p
+        for p, n in names.items()
+        if "TPU" in n or "GPU" in n or "device" in n.lower()
+    }
+
+
+def _thread_names(events) -> Dict[Tuple[Any, Any], str]:
+    return {
+        (e["pid"], e.get("tid")): str(e.get("args", {}).get("name", ""))
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+
+
+# device-process threads that hold actual executed work: the XLA op and
+# module lines (TPU) / streams (GPU). Everything ELSE under a device
+# pid is an umbrella view of the same time — "TensorFlow Name Scope"
+# spans named after the scopes themselves, "TensorFlow Ops", the
+# "Steps" line, TraceMe — and counting it would double-attribute every
+# category (or inflate "unattributed" by a full step per aux line).
+_OP_THREAD = re.compile(r"xla ops|xla modules|stream", re.I)
+
+
+def _split_events(
+    events, step_prefix: str
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Partition complete (``ph == "X"``) events into
+    ``(device_ops, module_events, host_events)``.
+
+    On TPU/GPU the device track is a distinct process; its executed-op
+    threads (see :data:`_OP_THREAD`; when the trace names threads at
+    all, only those count — unknown thread names are dropped rather
+    than risked as double counts) carry device time, with
+    ``step_prefix``-named events (e.g. ``jit_train_step``) as the
+    module level and the rest as ops. The CPU backend has no device
+    track — XLA op events land on the host process, identifiable by
+    their ``hlo_op`` metadata arg; runtime noise on the same pid
+    (executor bookkeeping, the PjitFunction span that would
+    double-count every op under it) stays host-side."""
+    real_dev = _real_device_pids(events)
+    tnames = _thread_names(events)
+    dev_threads_named = any(p in real_dev for p, _ in tnames)
+    device_ops: List[Dict[str, Any]] = []
+    module_evs: List[Dict[str, Any]] = []
+    host_evs: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        on_dev = e.get("pid") in real_dev
+        if on_dev and dev_threads_named and not _OP_THREAD.search(
+            tnames.get((e.get("pid"), e.get("tid")), "")
+        ):
+            continue  # aux umbrella line on the device process
+        if name.startswith(step_prefix) and (on_dev or not real_dev):
+            module_evs.append(e)
+        elif on_dev or "hlo_op" in (e.get("args") or {}):
+            device_ops.append(e)
+        else:
+            host_evs.append(e)
+    return device_ops, module_evs, host_evs
+
+
+_TRAILING_IDX = re.compile(r"[.\d]+$")
+
+
+def _span_of(event: Dict[str, Any], spans: Sequence[str]) -> Optional[str]:
+    """Innermost span on the event's metadata path, or None.
+
+    XLA op events carry the framework scope path (named_scope segments)
+    in metadata args — ``tf_op`` / ``long_name`` / ``scope`` depending
+    on backend and profiler version — and sometimes in the event name
+    itself. Segments are matched exactly after stripping trailing
+    ``.N`` disambiguators, scanning innermost-first."""
+    candidates = [str(event.get("name", ""))]
+    for v in (event.get("args") or {}).values():
+        if isinstance(v, str):
+            candidates.append(v)
+    for cand in candidates:
+        if "/" not in cand and cand not in spans:
+            # cheap pre-filter: a bare HLO name can still BE a span
+            # (host TraceAnnotations are bare), otherwise skip
+            base = _TRAILING_IDX.sub("", cand)
+            if base in spans:
+                return base
+            continue
+        segs = [s for s in cand.split("/") if s]
+        for seg in reversed(segs):  # innermost scope wins
+            base = _TRAILING_IDX.sub("", seg)
+            if base in spans:
+                return base
+    return None
+
+
+def attribute_trace(
+    source: TraceSource,
+    n_steps: int,
+    *,
+    flops_per_step: Optional[float] = None,
+    peak_tflops: Optional[float] = None,
+    step_prefix: str = "jit_",
+) -> Dict[str, Any]:
+    """Aggregate a trace into semantic per-category device ms/step.
+
+    - device-track op events are attributed to the innermost
+      :data:`DEVICE_SPANS` scope on their metadata path; the rest pools
+      under ``"unattributed"`` (raw HLO ops whose metadata names no
+      span — e.g. input transfers, or scopes added after this parser);
+    - module-level events (name starting with ``step_prefix``, e.g.
+      ``jit_train_step``) give ``step_total_ms``; where a backend
+      emits none (CPU), the op-duration sum stands in;
+    - host-track events named exactly a :data:`HOST_PHASES` phase
+      (the loop's TraceAnnotations) land in ``host_phases_ms_per_step``;
+    - MFU = flops_per_step / device-second / peak. ``flops_per_step``
+      falls back to per-op ``flops`` metadata summed from the trace
+      when the backend recorded it.
+    """
+    events = load_trace_events(source)
+    steps = max(int(n_steps or 0), 1)
+    device_ops, module_evs, host_evs = _split_events(events, step_prefix)
+
+    categories = {s: 0.0 for s in DEVICE_SPANS}
+    unattributed = 0.0
+    host = {p: 0.0 for p in HOST_PHASES}
+    op_total = 0.0
+    trace_flops = 0.0
+
+    for e in device_ops:
+        dur_ms = float(e.get("dur", 0)) / 1e3
+        f = (e.get("args") or {}).get("flops")
+        if isinstance(f, (int, float)):
+            trace_flops += float(f)
+        span = _span_of(e, DEVICE_SPANS)
+        if span is not None:
+            categories[span] += dur_ms
+        else:
+            unattributed += dur_ms
+        op_total += dur_ms
+    for e in host_evs:
+        phase = _span_of(e, HOST_PHASES)
+        if phase is not None:
+            host[phase] += float(e.get("dur", 0)) / 1e3
+
+    module_ms = sum(float(e.get("dur", 0)) / 1e3 for e in module_evs)
+    step_total = (
+        module_ms / steps if module_evs else (op_total / steps or None)
+    )
+    if flops_per_step is None and trace_flops > 0:
+        flops_per_step = trace_flops / steps
+    mfu = None
+    if step_total and flops_per_step and peak_tflops:
+        mfu = round(
+            flops_per_step / (step_total / 1e3) / (peak_tflops * 1e12), 4
+        )
+
+    out_cats = {
+        k: round(v / steps, 3) for k, v in categories.items() if v > 0.0
+    }
+    if unattributed > 0.0:
+        out_cats["unattributed"] = round(unattributed / steps, 3)
+    return {
+        "n_steps": steps,
+        "categories_ms_per_step": dict(
+            sorted(out_cats.items(), key=lambda kv: -kv[1])
+        ),
+        "step_total_ms": round(step_total, 3) if step_total else None,
+        "host_phases_ms_per_step": {
+            k: round(v / steps, 3) for k, v in host.items() if v > 0.0
+        },
+        "flops_per_step": flops_per_step,
+        "peak_tflops": peak_tflops,
+        "mfu": mfu,
+    }
+
+
+def hlo_breakdown(
+    source: TraceSource, n_steps: int, top: int = 10
+) -> Tuple[Dict[str, float], Optional[float]]:
+    """Legacy raw-HLO view (the shape of ``PROFILE_r04.json``):
+    device-track op durations (ms/step) grouped by normalized HLO op
+    name (trailing ``.N`` / digit suffixes stripped), top ``top``
+    groups + ``"other"``; plus the ms/step of the ``jit_train_step``
+    module events. Kept comparable with committed round-4/5 profiles;
+    new tooling should prefer :func:`attribute_trace`."""
+    events = load_trace_events(source)
+    steps = max(int(n_steps or 0), 1)
+    device_ops, module_evs, _ = _split_events(events, "jit_train_step")
+    groups: Dict[str, float] = {}
+    step_total = sum(float(e.get("dur", 0)) / 1e3 for e in module_evs)
+    for e in device_ops:
+        name = str(e.get("name", ""))
+        dur_ms = float(e.get("dur", 0)) / 1e3
+        base = _TRAILING_IDX.sub("", name)
+        groups[base] = groups.get(base, 0.0) + dur_ms
+    per_step = {
+        k: round(v / steps, 3)
+        for k, v in sorted(groups.items(), key=lambda kv: -kv[1])
+    }
+    out = dict(list(per_step.items())[:top])
+    rest = sum(list(per_step.values())[top:])
+    if rest:
+        out["other"] = round(rest, 3)
+    return out, (step_total / steps if step_total else None)
+
+
+def jit_step_ms(
+    source: TraceSource, prefix: str = "jit_train_step"
+) -> Optional[float]:
+    """Median on-device duration (ms) of module-level events named
+    ``prefix*`` — the tunnel-latency-free per-step number bench.py
+    reports as ``device_ms_per_step``."""
+    events = load_trace_events(source)
+    _, module_evs, _ = _split_events(events, prefix)
+    durs = sorted(float(e.get("dur", 0)) / 1e3 for e in module_evs)
+    return durs[len(durs) // 2] if durs else None
+
+
+# ---------------------------------------------------------------------------
+# capture windows (needs jax — imported lazily so obs stays stdlib)
+# ---------------------------------------------------------------------------
+
+
+class TraceCapture:
+    """Profiler windows at arbitrary ``(epoch, step)`` points.
+
+    Exception-safe by construction: ``_stop`` clears :attr:`active`
+    BEFORE calling ``jax.profiler.stop_trace()``, so a raise anywhere
+    between start and stop leads to exactly one stop — the loop's
+    ``finally`` calls :meth:`stop_if_active`, which is a no-op once a
+    normal-path :meth:`maybe_stop` has run, and a second failure inside
+    ``stop_trace`` itself cannot re-enter it.
+    """
+
+    def __init__(
+        self, trace_dir: str, windows: Sequence[Tuple[int, int, int]]
+    ) -> None:
+        self.trace_dir = trace_dir
+        self._pending = sorted(windows)
+        self.active: Optional[Dict[str, int]] = None
+
+    def maybe_start(self, epoch: int, step: int) -> bool:
+        """Open the window scheduled at this epoch with start step
+        ``<= step``, if any. ``<=`` tolerates a caller that skips step
+        indices (the loop calls per step, so normally it hits the start
+        step exactly). A window whose epoch is never visited (resume
+        past it) or whose start step exceeds the epoch's length cannot
+        fire — :meth:`unfired` reports those so the run can warn
+        instead of silently writing no trace."""
+        if self.active is not None:
+            return False
+        for i, (e, s, n) in enumerate(self._pending):
+            if e == epoch and step >= s:
+                import jax
+
+                del self._pending[i]
+                os.makedirs(self.trace_dir, exist_ok=True)
+                jax.profiler.start_trace(self.trace_dir)
+                self.active = {"epoch": epoch, "start_step": step, "steps": n}
+                return True
+        return False
+
+    def unfired(self) -> List[Tuple[int, int, int]]:
+        """Windows still pending — unreachable specs (epoch resumed
+        past, start step beyond the epoch's step count) end up here."""
+        return list(self._pending)
+
+    def maybe_stop(self, epoch: int, step: int, fence=None):
+        """Close the window once its step budget is traced. Returns the
+        window info dict when a stop happened, else None."""
+        if self.active is None:
+            return None
+        if step >= self.active["start_step"] + self.active["steps"] - 1:
+            return self._stop(fence)
+        return None
+
+    def stop_if_active(self, fence=None, last_step: Optional[int] = None):
+        """Failure/epoch-end path: flush an open window exactly once
+        (the profiler otherwise records forever and writes nothing).
+        ``last_step`` trims the window's reported step count when the
+        epoch ended short of the budget — the ms/step math downstream
+        must divide by steps actually traced."""
+        if self.active is None:
+            return None
+        if last_step is not None:
+            traced = max(last_step - self.active["start_step"] + 1, 1)
+            self.active["steps"] = min(self.active["steps"], traced)
+        return self._stop(fence)
+
+    def annotate(self, name: str):
+        """A ``TraceAnnotation(name)`` while a window is open (host
+        phase attribution), else a free nullcontext — the hot loop
+        stays unperturbed outside windows."""
+        if self.active is None:
+            return nullcontext()
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+
+    def _stop(self, fence):
+        import jax
+
+        info = dict(self.active)
+        # clear FIRST: if fence() or stop_trace() raises, no later
+        # finally-path call may stop a second time
+        self.active = None
+        try:
+            if fence is not None:
+                fence()  # drain queued steps so the trace holds them
+        finally:
+            jax.profiler.stop_trace()
+        info["trace_dir"] = self.trace_dir
+        return info

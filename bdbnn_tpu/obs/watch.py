@@ -1,0 +1,118 @@
+"""``watch`` — live status of a running (or finished) run directory.
+
+``python -m bdbnn_tpu.cli watch RUN_DIR [--interval S] [--once]``
+tails ``events.jsonl`` and re-renders a compact status block whenever
+the file grows: current epoch/step, last eval accuracy, flip-rate
+drift, the input-starvation flag, non-finite incidents, and the final
+verdict once ``run_end`` lands. Where ``summarize`` is the post-mortem,
+``watch`` is the heartbeat — same files, no JAX backend, so it can run
+on a laptop against a pod run's synced log dir.
+
+Stdlib-only (obs-package rule).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from bdbnn_tpu.obs.events import EVENTS_NAME, read_events
+from bdbnn_tpu.obs.summarize import INPUT_BOUND_SHARE
+
+
+def _mean(vals: List[float]) -> Optional[float]:
+    return sum(vals) / len(vals) if vals else None
+
+
+def render_status(events: List[Dict[str, Any]]) -> str:
+    """The status block for one snapshot of a run's event timeline."""
+    if not events:
+        return "(no events yet)"
+    start = next((e for e in events if e.get("kind") == "run_start"), None)
+    intervals = [e for e in events if e.get("kind") == "train_interval"]
+    evals = [e for e in events if e.get("kind") == "eval"]
+    nonfinite = [e for e in events if e.get("kind") == "nonfinite"]
+    end = next((e for e in events if e.get("kind") == "run_end"), None)
+    memory = [e for e in events if e.get("kind") == "memory"]
+
+    lines = []
+    if start:
+        lines.append(
+            f"run: epochs {start.get('start_epoch', 0)}->"
+            f"{start.get('epochs')} | {start.get('steps_per_epoch')} "
+            f"steps/epoch | config {start.get('config_hash', '?')}"
+        )
+    last = intervals[-1] if intervals else None
+    if last:
+        age = time.time() - float(last.get("t", time.time()))
+        share = float(last.get("data_wait_share", 0.0) or 0.0)
+        starved = " [INPUT-BOUND]" if share > INPUT_BOUND_SHARE else ""
+        lines.append(
+            f"train: epoch {last.get('epoch')} step {last.get('step')} | "
+            f"loss {last.get('loss')} | top1 {last.get('top1')} | "
+            f"{last.get('img_per_s')} img/s | data-wait "
+            f"{share:.0%}{starved} | {age:.0f}s ago"
+        )
+    if evals:
+        ev = evals[-1]
+        best = max(evals, key=lambda e: float(e.get("acc1", 0.0) or 0.0))
+        lines.append(
+            f"eval:  epoch {ev.get('epoch')} acc1 {ev.get('acc1')} "
+            f"(best {best.get('acc1')} @ epoch {best.get('epoch')})"
+        )
+    # flip-rate drift: mean over layers, first interval vs newest — the
+    # live view of "are binarized weights settling or still churning?"
+    flips_first = _mean(
+        [v for v in (intervals[0].get("flip_rate") or {}).values()
+         if v is not None]
+    ) if intervals else None
+    flips_last = _mean(
+        [v for v in (last.get("flip_rate") or {}).values() if v is not None]
+    ) if last else None
+    if flips_first is not None and flips_last is not None:
+        lines.append(
+            f"flips: mean rate {flips_first:.2e} -> {flips_last:.2e}"
+            + (" (settling)" if flips_last < flips_first else " (churning)")
+        )
+    if memory:
+        peaks = [e.get("peak_bytes") for e in memory if e.get("peak_bytes")]
+        if peaks:
+            lines.append(f"hbm:   peak {max(peaks) / 2**30:.2f} GiB")
+    if nonfinite:
+        lines.append(f"!! non-finite incidents: {len(nonfinite)}")
+    if end:
+        lines.append(
+            f"DONE: best acc1 {end.get('best_acc1')} @ epoch "
+            f"{end.get('best_epoch')} in {end.get('wall_s')}s"
+        )
+    return "\n".join(lines)
+
+
+def watch_run(
+    run_dir: str,
+    *,
+    interval: float = 2.0,
+    once: bool = False,
+    out=print,
+) -> int:
+    """Tail ``run_dir/events.jsonl``; re-render on growth; return once
+    ``run_end`` is seen (or immediately with ``once``)."""
+    path = os.path.join(run_dir, EVENTS_NAME)
+    last_size = -1
+    while True:
+        size = os.path.getsize(path) if os.path.exists(path) else 0
+        if size != last_size:
+            last_size = size
+            events = read_events(run_dir)
+            out(render_status(events))
+            if once or any(e.get("kind") == "run_end" for e in events):
+                return 0
+            out("---")
+        elif once:
+            out(render_status(read_events(run_dir)))
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0

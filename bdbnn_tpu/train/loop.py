@@ -21,6 +21,7 @@ Differences by design (TPU-first):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import time
@@ -51,7 +52,15 @@ from bdbnn_tpu.models import (
     module_path_str,
 )
 from bdbnn_tpu.models.torch_import import load_torch_checkpoint
-from bdbnn_tpu.obs import EventWriter, ObsHooks, StepPhaseTimer, write_manifest
+from bdbnn_tpu.obs import (
+    EventWriter,
+    ObsHooks,
+    StepPhaseTimer,
+    TraceCapture,
+    emit_memory_event,
+    parse_profile_at,
+    write_manifest,
+)
 from bdbnn_tpu.obs.probes import NonFiniteLossError, drain_probe_report
 from bdbnn_tpu.parallel import (
     create_sharded_state,
@@ -652,11 +661,26 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             best_acc1 = restored["best_acc1"]
         logger.info("resumed from %s at epoch %d", cfg.resume, start_epoch)
 
+    # --profile-at capture windows (arbitrary EPOCH:STEP[:NSTEPS]
+    # points); bare --profile-dir keeps its legacy meaning as the
+    # epoch-0 window at [profile_start, profile_start+profile_steps)
+    windows = [
+        parse_profile_at(spec, default_steps=cfg.profile_steps)
+        for spec in cfg.profile_at
+    ]
+    if not windows and cfg.profile_dir:
+        windows = [(0, cfg.profile_start, cfg.profile_steps)]
+    tracer = None
+    if windows:
+        trace_dir = cfg.profile_dir or os.path.join(log_path, "profile")
+        tracer = TraceCapture(trace_dir, windows)
+
     obs = ObsHooks(
         events=events,
         timer=StepPhaseTimer(),
         probe_sizes=probe_sizes,
         nonfinite_policy=cfg.nonfinite_policy,
+        tracer=tracer,
     )
 
     if cfg.evaluate:
@@ -723,6 +747,13 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 cfg.target_acc, epoch, time_to_target,
             )
 
+        # HBM watermark at the epoch boundary: one cheap allocator
+        # query per device per epoch, no device sync (memory event;
+        # obs/memory.py). The post-compile poll already pinned the
+        # steady-state footprint — these catch drift (fragmentation,
+        # eval-shape growth).
+        emit_memory_event(events, "epoch", jax.local_devices(), epoch=epoch)
+
         is_best = acc1 > best_acc1
         if is_best:
             best_epoch = epoch
@@ -735,6 +766,19 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         save_checkpoint(
             log_path, state,
             epoch=epoch, arch=cfg.arch, best_acc1=best_acc1, is_best=is_best,
+        )
+
+    if tracer is not None and tracer.unfired():
+        # an unreachable spec (epoch resumed past, start step beyond
+        # the epoch's step count) must not be discovered by rerunning
+        # an hours-long job that silently wrote no trace
+        logger.warning(
+            "--profile-at window(s) never fired (epoch resumed past, or "
+            "start step beyond the epoch's %d steps): %s",
+            steps_per_epoch,
+            ", ".join(
+                f"{e}:{s}:{n}" for e, s, n in tracer.unfired()
+            ),
         )
 
     events.emit(
@@ -823,6 +867,17 @@ def _interval_observe(
     )
 
 
+def _profile_window_done(obs, logger, info):
+    """A capture window closed: record the ``profile`` event `summarize`
+    keys its attribution section on, and tell the human."""
+    obs.events.emit("profile", **info)
+    logger.info(
+        "profiler trace written to %s (epoch %d steps %d..+%d)",
+        info["trace_dir"], info["epoch"], info["start_step"],
+        info["steps"] - 1,
+    )
+
+
 def _train_epoch(
     train_step, state, pipe, mesh, epoch, tk, kurt_gate, cfg,
     steps_per_epoch, logger, writer, obs=None,
@@ -834,7 +889,15 @@ def _train_epoch(
     wall time is perf_counter deltas around calls the loop already
     makes, probes come back inside the drained sums, and events are
     emitted only at drain points — the drain count per epoch is
-    identical with obs on or off (pinned by tests/test_obs.py)."""
+    identical with obs on or off (pinned by tests/test_obs.py).
+
+    Trace capture (``--profile-at`` windows, obs.tracer) is
+    exception-safe: the ``finally`` below flushes an open window
+    exactly once, so a step raising between start and stop can neither
+    leave the profiler recording forever nor double-stop it. While a
+    window is open, the loop's host phases are TraceAnnotation'd
+    (``data_wait`` / ``dispatch``) so the trace attributes host time
+    too; outside windows the annotations are free nullcontexts."""
     devmet = DeviceMetrics()
     loss_m = Mean("Loss", "{:.4e}")
     top1_m = Mean("Acc@1", "{:6.2f}")
@@ -845,9 +908,17 @@ def _train_epoch(
     progress = ProgressLog(steps_per_epoch, logger, prefix=f"Epoch: [{epoch}]")
     n_chips = max(jax.device_count(), 1)
     timer = obs.timer if obs is not None else None
+    tracer = obs.tracer if obs is not None else None
+    annot = (
+        tracer.annotate
+        if tracer is not None
+        else (lambda _name: contextlib.nullcontext())
+    )
 
-    profiling = bool(cfg.profile_dir) and epoch == 0
-    trace_active = False
+    def fence():
+        # drain queued steps so the trace holds the windowed work
+        jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+
     t_epoch = time.time()
 
     if timer is not None:
@@ -857,82 +928,106 @@ def _train_epoch(
         timer.reset()
     it = iter(pipe.epoch(epoch))
     step_idx = -1
-    while True:
-        t_mark = time.perf_counter()
-        try:
-            x, y = next(it)
-        except StopIteration:
-            break
-        step_idx += 1
-        if timer is not None:
-            timer.add("data_wait", time.perf_counter() - t_mark)
-        if profiling and not trace_active and step_idx == cfg.profile_start:
-            jax.profiler.start_trace(cfg.profile_dir)
-            trace_active = True
-        t_mark = time.perf_counter()
-        gx, gy = shard_batch(mesh, x, y)
-        state, m = train_step(state, (gx, gy), tk, kurt_gate)
-        devmet.add(m)
-        t_done = time.perf_counter()
-        if timer is not None:
-            timer.add("dispatch", t_done - t_mark)
-            if step_idx == 0 and timer.compile_s is None:
-                # the process's first call blocks the host on
-                # trace+compile (also when resuming at start_epoch>0);
-                # subsequent dispatches are sub-ms async enqueues, so
-                # this host-side duration IS the compile cost
-                timer.record_compile(t_done - t_mark)
-                obs.events.emit(
-                    "compile", seconds=round(t_done - t_mark, 3)
+    try:
+        while True:
+            # the window for the UPCOMING step opens before its data
+            # fetch, so the first traced step's data_wait annotation is
+            # inside the trace (host_phases ms/step divides by the full
+            # window — a late start would under-report data-wait)
+            if tracer is not None and tracer.maybe_start(epoch, step_idx + 1):
+                logger.info(
+                    "profiler trace started (epoch %d step %d) -> %s",
+                    epoch, step_idx + 1, tracer.trace_dir,
                 )
-        if (
-            trace_active
-            and step_idx >= cfg.profile_start + cfg.profile_steps - 1
-        ):
-            jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-            jax.profiler.stop_trace()
-            logger.info("profiler trace written to %s", cfg.profile_dir)
-            trace_active = False
-
-        if step_idx % cfg.print_freq == 0:
-            interval_steps = devmet.pending_steps
             t_mark = time.perf_counter()
-            sums = devmet.drain()  # the ONE host sync per interval
+            try:
+                with annot("data_wait"):
+                    x, y = next(it)
+            except StopIteration:
+                break
+            step_idx += 1
             if timer is not None:
-                timer.add("drain", time.perf_counter() - t_mark)
-            n = max(sums["count"], 1.0)
-            _add_component_means(comp_m, sums, interval_steps)
-            # loss_sum is example-weighted at the step (loss × count), so
-            # interval and epoch means are exact regardless of interval
-            # length (VERDICT r3 #6: /steps skewed short final intervals)
-            loss_m.add(sums["loss_sum"] / n, n)
-            top1_m.add(100.0 * sums["top1"] / n, n)
-            top5_m.add(100.0 * sums["top5"] / n, n)
-            rate = thr.tick(n)
-            _interval_observe(
-                obs, logger, epoch, step_idx, interval_steps, sums, n,
-                rate, probe_m,
-            )
-            progress.emit(
-                step_idx,
-                [
-                    loss_m.render(),
-                    top1_m.render(),
-                    top5_m.render(),
-                    f"img/s {rate:8.1f} ({rate / n_chips:7.1f}/chip)",
-                ],
-            )
-            sec_per_step = (time.time() - t_epoch) / max(step_idx + 1, 1)
-            remain_steps = (cfg.epochs - epoch) * steps_per_epoch - step_idx
-            logger.info(">>>>>>>>>>>> Remaining Time: %s <<<<<<<<<<<<",
-                        format_eta(remain_steps * sec_per_step))
+                timer.add("data_wait", time.perf_counter() - t_mark)
+            t_mark = time.perf_counter()
+            with annot("dispatch"):
+                gx, gy = shard_batch(mesh, x, y)
+                state, m = train_step(state, (gx, gy), tk, kurt_gate)
+            devmet.add(m)
+            t_done = time.perf_counter()
+            if timer is not None:
+                timer.add("dispatch", t_done - t_mark)
+                if step_idx == 0 and timer.compile_s is None:
+                    # the process's first call blocks the host on
+                    # trace+compile (also when resuming at
+                    # start_epoch>0); subsequent dispatches are sub-ms
+                    # async enqueues, so this host-side duration IS the
+                    # compile cost
+                    timer.record_compile(t_done - t_mark)
+                    obs.events.emit(
+                        "compile", seconds=round(t_done - t_mark, 3)
+                    )
+                    # the compiled program's HBM footprint, before any
+                    # training drift (memory event; obs/memory.py)
+                    emit_memory_event(
+                        obs.events, "post_compile", jax.local_devices(),
+                        epoch=epoch,
+                    )
+            if tracer is not None:
+                info = tracer.maybe_stop(epoch, step_idx, fence=fence)
+                if info is not None:
+                    _profile_window_done(obs, logger, info)
 
-    # a short epoch can end before the stop condition fired — flush the
-    # trace here or the profiler records forever and writes nothing
-    if trace_active:
-        jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-        jax.profiler.stop_trace()
-        logger.info("profiler trace written to %s", cfg.profile_dir)
+            if step_idx % cfg.print_freq == 0:
+                interval_steps = devmet.pending_steps
+                t_mark = time.perf_counter()
+                sums = devmet.drain()  # the ONE host sync per interval
+                if timer is not None:
+                    timer.add("drain", time.perf_counter() - t_mark)
+                n = max(sums["count"], 1.0)
+                _add_component_means(comp_m, sums, interval_steps)
+                # loss_sum is example-weighted at the step (loss ×
+                # count), so interval and epoch means are exact
+                # regardless of interval length (VERDICT r3 #6: /steps
+                # skewed short final intervals)
+                loss_m.add(sums["loss_sum"] / n, n)
+                top1_m.add(100.0 * sums["top1"] / n, n)
+                top5_m.add(100.0 * sums["top5"] / n, n)
+                rate = thr.tick(n)
+                _interval_observe(
+                    obs, logger, epoch, step_idx, interval_steps, sums, n,
+                    rate, probe_m,
+                )
+                progress.emit(
+                    step_idx,
+                    [
+                        loss_m.render(),
+                        top1_m.render(),
+                        top5_m.render(),
+                        f"img/s {rate:8.1f} ({rate / n_chips:7.1f}/chip)",
+                    ],
+                )
+                sec_per_step = (time.time() - t_epoch) / max(step_idx + 1, 1)
+                remain_steps = (cfg.epochs - epoch) * steps_per_epoch - step_idx
+                logger.info(">>>>>>>>>>>> Remaining Time: %s <<<<<<<<<<<<",
+                            format_eta(remain_steps * sec_per_step))
+    finally:
+        # EXACTLY-ONCE stop on every exit path: a short epoch that ends
+        # before the window's step budget, or a raising step mid-window
+        # (the profiler would otherwise record forever and write
+        # nothing — or, fenced naively, die a second death re-raising
+        # from block_until_ready and mask the original error)
+        if tracer is not None:
+            def _quiet_fence():
+                try:
+                    fence()
+                except Exception:
+                    pass  # the original exception is already in flight
+
+            info = tracer.stop_if_active(
+                fence=_quiet_fence, last_step=step_idx
+            )
+            if info is not None:
+                _profile_window_done(obs, logger, info)
 
     # final partial interval + epoch means
     if devmet.pending_steps:

@@ -132,10 +132,11 @@ def _step_metrics(
         "count": jnp.int32(labels.shape[0]),
     }
     if cfg.probe_paths:
-        metrics.update(
-            probe_metrics(old_params, new_params, cfg.probe_paths,
-                          cfg.probe_names)
-        )
+        with jax.named_scope("probes"):
+            metrics.update(
+                probe_metrics(old_params, new_params, cfg.probe_paths,
+                              cfg.probe_names)
+            )
     if cfg.track_nonfinite:
         metrics["nonfinite"] = nonfinite_flag(aux["loss"])
     return metrics
@@ -170,8 +171,11 @@ def make_train_step(
             return loss, (mutated["batch_stats"], aux)
 
         grads, (new_bs, aux) = jax.grad(loss_fn, has_aux=True)(state.params)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        # "optimizer" named scope: the optax update attributes as its
+        # own device trace category (obs/trace.py DEVICE_SPANS)
+        with jax.named_scope("optimizer"):
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         logits = aux.pop("logits")
         metrics = _step_metrics(
             aux, logits, labels, grads, state.params, new_params, cfg
@@ -249,8 +253,9 @@ def make_ts_train_step(
             return loss, (mutated["batch_stats"], aux)
 
         grads, (new_bs, aux) = jax.grad(loss_fn, has_aux=True)(state.params)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        with jax.named_scope("optimizer"):
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         logits = aux.pop("logits")
         metrics = _step_metrics(
             aux, logits, labels, grads, state.params, new_params, cfg

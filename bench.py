@@ -58,6 +58,12 @@ import subprocess
 import sys
 import time
 
+from bdbnn_tpu.obs.trace import (  # stdlib-importable (no jax init)
+    BF16_PEAK_TFLOPS,
+    find_trace_file,
+    jit_step_ms,
+)
+
 BASELINE_IMAGES_PER_SEC_PER_CHIP = float(
     os.environ.get("BDBNN_BENCH_BASELINE", "900.0")
 )
@@ -66,21 +72,6 @@ UNIT = "images/sec/chip"
 # steps traced by _profile_device_ms; consumers dividing aggregate
 # trace durations into per-step numbers (profile_r05.py) must use THIS
 PROFILE_TRACE_STEPS = 5
-
-# Published per-chip dense bf16 peaks (TFLOP/s). Keyed on
-# jax.devices()[0].device_kind. Sources: Google Cloud TPU system
-# architecture docs (v2-v6e product pages).
-BF16_PEAK_TFLOPS = {
-    "TPU v2": 22.5,
-    "TPU v3": 61.5,
-    "TPU v4": 275.0,  # one megacore device per chip
-    "TPU v5 lite": 197.0,  # v5e
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,       # v5p reports device_kind "TPU v5"
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,  # v6e (Trillium)
-    "TPU v6e": 918.0,
-}
 
 
 def _build_step(dtype: str, batch: int):
@@ -186,10 +177,8 @@ def _measure_compiled(compiled, state, batch_xy, tk, gate, batch: int,
 def _profile_device_ms(compiled, state, batch_xy, tk, gate, batch: int,
                        profile_dir: str):
     """Trace 5 steps of the already-compiled step; return median
-    on-device jit_train_step ms."""
-    import glob
-    import gzip
-
+    on-device jit_train_step ms (parsed by the shared semantic-trace
+    module, obs/trace.py)."""
     import jax
 
     os.makedirs(profile_dir, exist_ok=True)
@@ -199,31 +188,10 @@ def _profile_device_ms(compiled, state, batch_xy, tk, gate, batch: int,
             s, m = compiled(s, batch_xy, tk, gate)
         _ = float(m["loss"])
 
-    traces = sorted(
-        glob.glob(os.path.join(profile_dir, "plugins/profile/*/*.trace.json.gz"))
-    )
-    if not traces:
+    trace_path = find_trace_file(profile_dir)
+    if trace_path is None:
         return None, None, s
-    with gzip.open(traces[-1]) as f:
-        tr = json.load(f)
-    events = tr.get("traceEvents", [])
-    pids = {
-        e["pid"]: e["args"].get("name", "")
-        for e in events
-        if e.get("ph") == "M" and e.get("name") == "process_name"
-    }
-    device_pids = {p for p, n in pids.items() if "TPU" in n or "device" in n.lower()}
-    durs = [
-        e["dur"] / 1e3
-        for e in events
-        if e.get("ph") == "X"
-        and e.get("pid") in device_pids
-        and str(e.get("name", "")).startswith("jit_train_step")
-    ]
-    if not durs:
-        return None, traces[-1], s
-    durs.sort()
-    return durs[len(durs) // 2], traces[-1], s
+    return jit_step_ms(trace_path, prefix="jit_train_step"), trace_path, s
 
 
 def worker_main(args) -> None:

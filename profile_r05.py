@@ -5,10 +5,13 @@ device MFU or a written analysis of the residual).
 
 Reuses bench.py's compiled flagship step (BASELINE config 3 workload:
 binary ResNet-18 react @ 224², bf16, batch 128, fwd+bwd+Adam+19-layer
-kurtosis) and its fenced measurement; adds a per-op device-time
-breakdown aggregated from the jax.profiler trace, in the same category
-shape as profiles/r04/PROFILE_r04.json so the two are directly
-comparable.
+kurtosis) and its fenced measurement. Trace parsing lives in the
+shared :mod:`bdbnn_tpu.obs.trace` module (this script's one-off
+``_trace_breakdown`` was promoted there): the legacy raw-HLO grouping
+keeps the output directly comparable with profiles/r04/PROFILE_r04.json,
+and the semantic span attribution (binarize / binary_conv / bn_act /
+kurtosis_loss / optimizer / ...) rides along under
+``device_attribution_ms_per_step``.
 
 Run on the real chip (dies fast if the tunnel is down):
     python profile_r05.py [--batch 128] [--iters 20]
@@ -17,55 +20,14 @@ Run on the real chip (dies fast if the tunnel is down):
 from __future__ import annotations
 
 import argparse
-import collections
 import datetime
-import glob
-import gzip
 import json
 import os
-import re
 import shutil
 import sys
 
 import bench
-
-
-def _trace_breakdown(trace_path: str, n_steps: int):
-    """Aggregate device-track op durations (ms/step) by normalized HLO
-    op name (trailing .N / digit suffixes stripped), top groups +
-    'other'."""
-    with gzip.open(trace_path) as f:
-        tr = json.load(f)
-    events = tr.get("traceEvents", [])
-    pids = {
-        e["pid"]: e["args"].get("name", "")
-        for e in events
-        if e.get("ph") == "M" and e.get("name") == "process_name"
-    }
-    device_pids = {
-        p for p, n in pids.items() if "TPU" in n or "device" in n.lower()
-    }
-    groups: dict = collections.defaultdict(float)
-    step_total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        name = str(e.get("name", ""))
-        dur_ms = e.get("dur", 0) / 1e3
-        if name.startswith("jit_train_step"):
-            step_total += dur_ms
-            continue
-        base = re.sub(r"[.\d]+$", "", name)
-        groups[base] += dur_ms
-    per_step = {
-        k: round(v / max(n_steps, 1), 3)
-        for k, v in sorted(groups.items(), key=lambda kv: -kv[1])
-    }
-    top = dict(list(per_step.items())[:10])
-    rest = sum(list(per_step.values())[10:])
-    if rest:
-        top["other"] = round(rest, 3)
-    return top, (step_total / max(n_steps, 1) if step_total else None)
+from bdbnn_tpu.obs.trace import attribute_trace, hlo_breakdown
 
 
 def main():
@@ -94,13 +56,20 @@ def main():
     dev_ms, trace_path, state = bench._profile_device_ms(
         compiled, state, batch_xy, tk, gate, args.batch, trace_dir
     )
-    breakdown, step_total_ms = (
-        _trace_breakdown(trace_path, bench.PROFILE_TRACE_STEPS)
-        if trace_path
-        else ({}, None)
-    )
-
     peak = bench.BF16_PEAK_TFLOPS.get(dev.device_kind)
+    if trace_path:
+        breakdown, step_total_ms = hlo_breakdown(
+            trace_path, bench.PROFILE_TRACE_STEPS
+        )
+        attribution = attribute_trace(
+            trace_path,
+            bench.PROFILE_TRACE_STEPS,
+            flops_per_step=flops or None,
+            peak_tflops=peak,
+        )
+    else:
+        breakdown, step_total_ms, attribution = {}, None, None
+
     dev_rate = args.batch / (dev_ms / 1e3) if dev_ms else None
     out = {
         "what": (
@@ -135,6 +104,12 @@ def main():
             else None
         ),
         "device_time_breakdown_ms_per_step": breakdown,
+        "device_attribution_ms_per_step": (
+            attribution["categories_ms_per_step"] if attribution else None
+        ),
+        "device_attribution_mfu": (
+            attribution["mfu"] if attribution else None
+        ),
         "device_track_total_ms_per_step": (
             round(step_total_ms, 2) if step_total_ms else None
         ),

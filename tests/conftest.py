@@ -37,7 +37,7 @@ import pytest  # noqa: E402
 _FAST_MODULES = {"test_binarize", "test_kurtosis", "test_kd", "test_cli"}
 _FAST_CLASSES = {"TestOptimizerParity", "TestEDESchedule"}
 # in fast modules but not fast: real subprocesses that import jax
-_NOT_FAST_CLASSES = {"TestSummarizeSubcommand"}
+_NOT_FAST_CLASSES = {"TestSummarizeSubcommand", "TestWatchSubcommand"}
 
 
 def pytest_collection_modifyitems(config, items):
@@ -54,6 +54,86 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def write_synthetic_trace(path, n_steps=5):
+    """A hand-built ``*.trace.json.gz`` in the Chrome-trace shape the
+    jax profiler emits on TPU: a device process with named threads —
+    "XLA Modules" (module-level jit_train_step events), "XLA Ops" (op
+    events whose ``tf_op`` metadata carries named-scope paths + one
+    unnamed HLO fusion), plus the aux umbrella lines a real trace
+    carries ("TensorFlow Name Scope" spans named after the scopes
+    themselves, the "Steps" line) which re-describe the same time and
+    must NOT be counted — and a host track with data_wait/dispatch
+    TraceAnnotations and runtime noise. Durations are microseconds.
+    Per-step ms the parser must recover: binarize 1.0, binary_conv
+    4.0, bn_act 1.5, kurtosis_loss 2.0, optimizer 0.5, unattributed
+    1.0; step total 10.0; host data_wait 3.0 + dispatch 0.25."""
+    import gzip
+    import json
+    import os
+
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/host:CPU python"}},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 1, "tid": 3, "name": "thread_name",
+         "args": {"name": "TensorFlow Name Scope"}},
+        {"ph": "M", "pid": 1, "tid": 4, "name": "thread_name",
+         "args": {"name": "Steps"}},
+    ]
+    t = 0
+    for step in range(n_steps):
+        events.append({"ph": "X", "pid": 1, "tid": 1, "ts": t,
+                       "dur": 10_000, "name": f"jit_train_step.{step}",
+                       "args": {}})
+        # aux umbrella lines: scope-named spans + the step marker —
+        # the same device time AGAIN; counting them would double every
+        # scoped category and add a phantom step of "unattributed"
+        events.append({"ph": "X", "pid": 1, "tid": 3, "ts": t,
+                       "dur": 1_000, "name": "binarize", "args": {}})
+        events.append({"ph": "X", "pid": 1, "tid": 3, "ts": t + 1_000,
+                       "dur": 2_000, "name": "kurtosis_loss", "args": {}})
+        events.append({"ph": "X", "pid": 1, "tid": 4, "ts": t,
+                       "dur": 10_000, "name": str(step), "args": {}})
+        for dur_us, name, tf_op in (
+            (1_000, "fusion.1",
+             "jit(train_step)/binarize/sign"),
+            (4_000, "convolution.2",
+             "jit(train_step)/binary_conv/conv_general_dilated"),
+            (1_500, "fusion.3",
+             "jit(train_step)/bn_act/batch_norm"),
+            (2_000, "reduce.4",
+             "jit(train_step)/kurtosis_loss/reduce_sum"),
+            (500, "fusion.5",
+             "jit(train_step)/optimizer/add"),
+            # an unnamed HLO op: no scope on its metadata path
+            (1_000, "fusion.77", None),
+        ):
+            args = {"hlo_op": name}
+            if tf_op:
+                args["tf_op"] = tf_op
+            events.append({"ph": "X", "pid": 1, "tid": 2, "ts": t,
+                           "dur": dur_us, "name": name, "args": args})
+        # host track: the loop's TraceAnnotations + runtime noise that
+        # must NOT be attributed anywhere
+        events.append({"ph": "X", "pid": 2, "tid": 9, "ts": t,
+                       "dur": 3_000, "name": "data_wait", "args": {}})
+        events.append({"ph": "X", "pid": 2, "tid": 9, "ts": t + 3_000,
+                       "dur": 250, "name": "dispatch", "args": {}})
+        events.append({"ph": "X", "pid": 2, "tid": 9, "ts": t,
+                       "dur": 11_000, "name": "PjitFunction(train_step)",
+                       "args": {}})
+        t += 12_000
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
 
 
 def _write_fixture_run_dir(path):
@@ -74,8 +154,8 @@ def _write_fixture_run_dir(path):
         "config": {"arch": "resnet20", "epochs": 3},
         "jax_version": "0.4.37",
         "jaxlib_version": "0.4.36",
-        "backend": "cpu",
-        "device_kind": "cpu",
+        "backend": "tpu",
+        "device_kind": "TPU v5e",
         "device_count": 8,
         "local_device_count": 8,
         "process_index": 0,
@@ -130,6 +210,26 @@ def _write_fixture_run_dir(path):
         events.append({"t": t, "kind": "eval", "epoch": epoch,
                        "acc1": 30.0 * (1 + epoch), "acc5": 80.0,
                        "loss": 1.5 - 0.4 * epoch})
+    # a --profile-at capture window + HBM watermarks, backing the
+    # summarize attribution section (trace file under <run>/profile)
+    trace_dir = os.path.join(path, "profile")
+    write_synthetic_trace(
+        os.path.join(trace_dir, "fixture.trace.json.gz"), n_steps=5
+    )
+    # flops chosen so MFU vs the v5e 197 TFLOP/s peak over the 10
+    # ms/step trace total is exactly 0.5
+    events.append({"t": t + 0.5, "kind": "profile", "epoch": 2,
+                   "start_step": 1, "steps": 5, "trace_dir": trace_dir,
+                   "flops_per_step": 0.985e12})
+    events.append({"t": 104.0, "kind": "memory", "phase": "post_compile",
+                   "available": True,
+                   "devices": [{"device": "0", "bytes_in_use": 2 * 2**30,
+                                "peak_bytes_in_use": 6 * 2**30,
+                                "bytes_limit": 16 * 2**30}],
+                   "peak_bytes": 6 * 2**30, "limit_bytes": 16 * 2**30})
+    events.append({"t": t + 0.6, "kind": "memory", "phase": "epoch",
+                   "epoch": 2, "available": True, "devices": [],
+                   "peak_bytes": 8 * 2**30, "limit_bytes": 16 * 2**30})
     events.append({"t": t + 1.0, "kind": "run_end", "best_acc1": 90.0,
                    "best_epoch": 2, "wall_s": t - 99.0})
     with open(os.path.join(path, "events.jsonl"), "w") as f:

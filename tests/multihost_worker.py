@@ -33,6 +33,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# The CPU PJRT backend compiles multi-process collectives only when a
+# cross-host collectives implementation is configured; without this the
+# first non-addressable device_put dies with "Multiprocess computations
+# aren't implemented on the CPU backend" (its default is a
+# single-process stub). TPU/GPU backends ship their own (ICI/NCCL) —
+# this knob exists for, and only affects, CPU clusters.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=f"127.0.0.1:{port}",
     num_processes=num_procs,

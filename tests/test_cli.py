@@ -138,6 +138,15 @@ class TestTpuNativeFlags:
         cfg = parse(["/data"])
         assert cfg.probe_binarization
         assert cfg.nonfinite_policy == "raise"
+        assert cfg.profile_at == ()
+
+    def test_profile_at_flag(self):
+        cfg = parse(["/data", "--profile-at", "0:5:3",
+                     "--profile-at", "12:40"])
+        assert cfg.profile_at == ("0:5:3", "12:40")
+        cfg.validate()  # specs parse
+        with pytest.raises(ValueError, match="profile-at"):
+            parse(["/data", "--profile-at", "nonsense"]).validate()
 
 
 class TestSummarizeSubcommand:
@@ -169,5 +178,56 @@ class TestSummarizeSubcommand:
         assert summary["starvation"]["input_bound"] is True
 
     def test_summarize_empty_dir_fails(self, tmp_path):
+        proc = self._run(str(tmp_path))
+        assert proc.returncode != 0
+
+    def test_summarize_renders_attribution(self, fixture_run_dir):
+        """The fixture run dir carries a capture window + memory
+        events; the CLI report must render the attribution section
+        with SEMANTIC category names and the HBM watermark."""
+        proc = self._run(fixture_run_dir)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "device attribution" in proc.stdout
+        assert "binary_conv" in proc.stdout
+        assert "hbm: peak" in proc.stdout
+
+        proc = self._run(fixture_run_dir, "--json")
+        summary = json.loads(proc.stdout)
+        cats = summary["attribution"]["categories_ms_per_step"]
+        assert cats["binary_conv"] == pytest.approx(4.0)
+        assert summary["attribution"]["hbm"]["peak_gib"] == pytest.approx(8.0)
+
+
+class TestWatchSubcommand:
+    """``python -m bdbnn_tpu.cli watch RUN_DIR --once`` — the live-tail
+    status view, as a real subprocess against the fixture run dir. Like
+    summarize, it reads files only (works on a synced log dir with no
+    live process, and must not initialize a JAX backend)."""
+
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "bdbnn_tpu.cli", "watch", *argv],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    def test_watch_once(self, fixture_run_dir):
+        proc = self._run(fixture_run_dir, "--once")
+        assert proc.returncode == 0, proc.stderr[-800:]
+        out = proc.stdout
+        # epoch progress, latest eval, flip drift, completion verdict
+        assert "epochs 0->3" in out
+        assert "eval:" in out and "best 90.0" in out
+        assert "flips:" in out and "settling" in out
+        assert "hbm:" in out
+        assert "DONE: best acc1 90.0 @ epoch 2" in out
+
+    def test_watch_resolves_log_root(self, fixture_run_dir):
+        proc = self._run(os.path.dirname(fixture_run_dir), "--once")
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "DONE" in proc.stdout
+
+    def test_watch_empty_dir_fails(self, tmp_path):
         proc = self._run(str(tmp_path))
         assert proc.returncode != 0

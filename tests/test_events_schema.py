@@ -1,0 +1,155 @@
+"""CI guard for the structured event channel (obs/events.py).
+
+Two invariants that keep ``events.jsonl`` machine-readable forever:
+
+1. **Registered kinds.** Every ``*.emit(...)`` call site in the package
+   (plus the bench/profile harnesses) passes a LITERAL kind string that
+   is registered in ``events.KNOWN_KINDS`` — a new event kind added
+   without registration fails here, so the docs/registry can't drift
+   from the code.
+
+2. **Strict RFC 8259.** Whatever a call site passes — NaN/Inf floats,
+   numpy scalars, nested dicts of them — the emitted line round-trips
+   through ``json.loads`` with ``parse_constant`` raising, i.e. no bare
+   ``NaN``/``Infinity`` tokens and no repr-string smuggling of numeric
+   values. This is what keeps jq / non-Python consumers working on a
+   warn-policy run's telemetry.
+"""
+
+import ast
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bdbnn_tpu.obs.events import KNOWN_KINDS, EventWriter, jsonsafe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# everything that writes events: the package, plus the root-level
+# harnesses that share the channel
+SCANNED = sorted(
+    glob.glob(os.path.join(REPO, "bdbnn_tpu", "**", "*.py"), recursive=True)
+) + [os.path.join(REPO, "bench.py"), os.path.join(REPO, "profile_r05.py")]
+
+
+def _emit_calls(path):
+    """(lineno, first-arg AST node) for every ``<obj>.emit(...)`` call.
+
+    ``EventWriter.emit``'s own definition isn't a call; dict ``.items``
+    etc. don't match the attribute name."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+        ):
+            # ProgressLog.emit(step, parts) takes an int first — only
+            # event emits pass a string literal or anything else; the
+            # literal-kind assertion below separates them
+            out.append((node.lineno, node.args[0] if node.args else None))
+    return out
+
+
+class TestEmitCallSites:
+    def test_every_emit_kind_is_registered(self):
+        """Every event-channel emit passes a literal, registered kind."""
+        unregistered = []
+        found = set()
+        for path in SCANNED:
+            for lineno, arg in _emit_calls(path):
+                if not isinstance(arg, ast.Constant) or not isinstance(
+                    arg.value, str
+                ):
+                    # not the event channel (ProgressLog.emit's first
+                    # arg is a step index; **info-style relays are
+                    # covered by the registry test on their kind field)
+                    continue
+                found.add(arg.value)
+                if arg.value not in KNOWN_KINDS:
+                    unregistered.append(
+                        f"{os.path.relpath(path, REPO)}:{lineno}: "
+                        f"emit({arg.value!r})"
+                    )
+        assert not unregistered, (
+            "event kinds missing from obs.events.KNOWN_KINDS:\n"
+            + "\n".join(unregistered)
+        )
+        # the scan actually saw the package's core kinds (guards
+        # against the AST walk silently matching nothing)
+        assert {"run_start", "compile", "train_interval", "eval",
+                "memory", "profile", "run_end"} <= found
+
+    def test_registry_matches_docs(self):
+        """KNOWN_KINDS and the events.py module docstring stay in sync."""
+        import bdbnn_tpu.obs.events as ev
+
+        for kind in KNOWN_KINDS:
+            assert f"``{kind}``" in ev.__doc__, (
+                f"event kind {kind!r} not documented in obs/events.py"
+            )
+
+
+class TestStrictRfc8259:
+    def _strict(self, line):
+        def no_constants(s):
+            raise AssertionError(f"bare {s} token in events.jsonl")
+
+        return json.loads(line, parse_constant=no_constants)
+
+    def test_adversarial_payload_roundtrips(self, tmp_path):
+        """NaN/Inf, numpy scalars (float32 is NOT a Python float and
+        used to leak through as a repr string), 0-d arrays, nesting."""
+        ev = EventWriter(str(tmp_path))
+        ev.emit(
+            "train_interval",
+            loss=float("nan"),
+            neg=float("-inf"),
+            np32=np.float32(1.5),
+            np32_nan=np.float32("nan"),
+            np64=np.float64(2.5),
+            npint=np.int64(7),
+            npbool=np.bool_(True),
+            zerod=np.asarray(3.25),
+            nested={"k": {"deep": np.float32("inf")}},
+            arr=[np.float32(0.5), float("inf"), 2],
+        )
+        ev.close()
+        with open(ev.path) as f:
+            rec = self._strict(f.read().strip())
+        assert rec["loss"] is None and rec["neg"] is None
+        assert rec["np32"] == 1.5 and isinstance(rec["np32"], float)
+        assert rec["np32_nan"] is None
+        assert rec["np64"] == 2.5
+        assert rec["npint"] == 7 and isinstance(rec["npint"], int)
+        assert rec["npbool"] is True
+        assert rec["zerod"] == 3.25
+        assert rec["nested"]["k"]["deep"] is None
+        assert rec["arr"] == [0.5, None, 2]
+
+    def test_every_known_kind_emits_strict(self, tmp_path):
+        """One adversarial record per registered kind: whatever fields
+        a future call site adds, the envelope machinery keeps the line
+        parseable."""
+        ev = EventWriter(str(tmp_path))
+        for kind in sorted(KNOWN_KINDS):
+            ev.emit(kind, value=float("nan"),
+                    per_layer={"l1": np.float32("-inf")})
+        ev.close()
+        with open(ev.path) as f:
+            lines = [l for l in f if l.strip()]
+        assert len(lines) == len(KNOWN_KINDS)
+        for line in lines:
+            rec = self._strict(line)
+            assert rec["kind"] in KNOWN_KINDS
+            assert rec["value"] is None
+            assert rec["per_layer"]["l1"] is None
+
+    def test_jsonsafe_bool_and_int_untouched(self):
+        assert jsonsafe(True) is True
+        assert jsonsafe(0) == 0 and jsonsafe(0) is not False
+        assert jsonsafe("NaN") == "NaN"  # strings pass through

@@ -16,7 +16,12 @@ from bdbnn_tpu.configs.config import RunConfig
 from bdbnn_tpu.obs import (
     EventWriter,
     RunManifest,
+    TraceCapture,
+    attribute_trace,
     config_hash,
+    hlo_breakdown,
+    jit_step_ms,
+    parse_profile_at,
     read_events,
     read_manifest,
     summarize_run,
@@ -24,6 +29,7 @@ from bdbnn_tpu.obs import (
 )
 from bdbnn_tpu.obs.probes import NonFiniteLossError, drain_probe_report
 from bdbnn_tpu.train.loop import fit
+from conftest import write_synthetic_trace
 
 # the shared fit: 256 examples / batch 64 = 4 steps, print_freq 2
 STEPS = 4
@@ -285,6 +291,264 @@ class TestProbeMath:
         assert kurt["a"] == pytest.approx(2.5)
 
 
+class TestTraceParser:
+    """The semantic-attribution parser against a hand-built trace
+    (device + host tracks, named scopes, an unnamed HLO op) — pins the
+    category aggregation and the ms/step math."""
+
+    @pytest.fixture
+    def trace_path(self, tmp_path):
+        return write_synthetic_trace(
+            str(tmp_path / "plugins" / "profile" / "x" / "t.trace.json.gz"),
+            n_steps=5,
+        )
+
+    def test_category_aggregation_and_ms_math(self, trace_path):
+        att = attribute_trace(trace_path, 5)
+        cats = att["categories_ms_per_step"]
+        assert cats["binarize"] == pytest.approx(1.0)
+        assert cats["binary_conv"] == pytest.approx(4.0)
+        assert cats["bn_act"] == pytest.approx(1.5)
+        assert cats["kurtosis_loss"] == pytest.approx(2.0)
+        assert cats["optimizer"] == pytest.approx(0.5)
+        # the unnamed HLO op pools under "unattributed", never a span
+        assert cats["unattributed"] == pytest.approx(1.0)
+        # module-level jit_train_step events give the step total
+        assert att["step_total_ms"] == pytest.approx(10.0)
+        # categories render most-expensive first
+        assert list(cats)[0] == "binary_conv"
+
+    def test_host_phases_not_device_noise(self, trace_path):
+        att = attribute_trace(trace_path, 5)
+        host = att["host_phases_ms_per_step"]
+        assert host["data_wait"] == pytest.approx(3.0)
+        assert host["dispatch"] == pytest.approx(0.25)
+        # the host-track PjitFunction umbrella span (11 ms/step) must
+        # not leak into device categories — that would double-count
+        # every op under it
+        total_attr = sum(att["categories_ms_per_step"].values())
+        assert total_attr == pytest.approx(10.0)
+
+    def test_aux_device_tracks_not_double_counted(self, trace_path):
+        """Real TPU traces re-describe device time on umbrella threads
+        under the SAME device pid ("TensorFlow Name Scope" spans named
+        after the scopes themselves, the "Steps" line). The fixture
+        carries both; counting them would double binarize (1->2 ms)
+        and kurtosis_loss (2->4 ms) and add a phantom 10 ms/step of
+        unattributed Steps time."""
+        att = attribute_trace(trace_path, 5)
+        cats = att["categories_ms_per_step"]
+        assert cats["binarize"] == pytest.approx(1.0)
+        assert cats["kurtosis_loss"] == pytest.approx(2.0)
+        assert cats["unattributed"] == pytest.approx(1.0)
+
+    def test_mfu_estimate(self, trace_path):
+        # 0.985e12 flops / 10 ms step / 197 TFLOP/s peak = 50% MFU
+        att = attribute_trace(
+            trace_path, 5, flops_per_step=0.985e12, peak_tflops=197.0
+        )
+        assert att["mfu"] == pytest.approx(0.5)
+        # no peak -> no MFU, everything else intact
+        att = attribute_trace(trace_path, 5, flops_per_step=0.985e12)
+        assert att["mfu"] is None
+
+    def test_hlo_breakdown_legacy_shape(self, trace_path):
+        groups, step_total = hlo_breakdown(trace_path, 5)
+        # trailing .N stripped, grouped, ms/step
+        assert groups["convolution"] == pytest.approx(4.0)
+        assert groups["fusion"] == pytest.approx(4.0)  # 1.0+1.5+0.5+1.0
+        assert groups["reduce"] == pytest.approx(2.0)
+        assert step_total == pytest.approx(10.0)
+
+    def test_jit_step_ms_median(self, trace_path):
+        assert jit_step_ms(trace_path) == pytest.approx(10.0)
+
+    def test_profile_at_spec(self):
+        assert parse_profile_at("12:40:8") == (12, 40, 8)
+        assert parse_profile_at("0:5", default_steps=7) == (0, 5, 7)
+        for bad in ("5", "1:2:3:4", "a:b", "1:-2", "1:2:0"):
+            with pytest.raises(ValueError):
+                parse_profile_at(bad)
+
+
+class TestTraceCapture:
+    """Exception safety: stop_trace runs exactly once on the failure
+    path — a raised step between start and stop must neither leave the
+    profiler running nor double-stop it."""
+
+    @pytest.fixture
+    def profiler_spy(self, monkeypatch):
+        import jax.profiler
+
+        calls = {"start": 0, "stop": 0}
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda d: calls.__setitem__("start", calls["start"] + 1),
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace",
+            lambda: calls.__setitem__("stop", calls["stop"] + 1),
+        )
+        return calls
+
+    def test_normal_window(self, tmp_path, profiler_spy):
+        cap = TraceCapture(str(tmp_path / "tr"), [(1, 2, 3)])
+        assert not cap.maybe_start(0, 2)  # wrong epoch
+        assert not cap.maybe_start(1, 1)  # before the start step
+        assert cap.maybe_start(1, 2)
+        assert cap.active
+        assert cap.maybe_stop(1, 3) is None  # budget is 3 steps
+        info = cap.maybe_stop(1, 4)
+        assert info == {
+            "epoch": 1, "start_step": 2, "steps": 3,
+            "trace_dir": str(tmp_path / "tr"),
+        }
+        assert profiler_spy == {"start": 1, "stop": 1}
+        # idle finally-path call: no second stop
+        assert cap.stop_if_active() is None
+        assert profiler_spy["stop"] == 1
+
+    def test_raise_between_start_and_stop(self, tmp_path, profiler_spy):
+        cap = TraceCapture(str(tmp_path / "tr"), [(0, 0, 5)])
+        assert cap.maybe_start(0, 0)
+        # the step raised; the loop's finally flushes the window with a
+        # short actual step count
+        info = cap.stop_if_active(last_step=1)
+        assert info["steps"] == 2  # trimmed to steps actually traced
+        assert profiler_spy == {"start": 1, "stop": 1}
+        assert cap.stop_if_active() is None  # exactly once
+        assert profiler_spy["stop"] == 1
+
+    def test_fence_failure_still_stops(self, tmp_path, profiler_spy):
+        cap = TraceCapture(str(tmp_path / "tr"), [(0, 0, 5)])
+        cap.maybe_start(0, 0)
+
+        def bad_fence():
+            raise RuntimeError("device died")
+
+        with pytest.raises(RuntimeError, match="device died"):
+            cap.maybe_stop(0, 4, fence=bad_fence)
+        # the trace was still stopped, exactly once, and the capture
+        # is inert afterwards
+        assert profiler_spy == {"start": 1, "stop": 1}
+        assert cap.active is None
+        assert cap.stop_if_active() is None
+        assert profiler_spy["stop"] == 1
+
+    def test_late_start_fires_past_window_step(self, tmp_path, profiler_spy):
+        # a start call that overshoots the requested step still opens
+        # the window (>=), rather than never firing
+        cap = TraceCapture(str(tmp_path / "tr"), [(0, 100, 2)])
+        assert not cap.maybe_start(0, 99)
+        assert cap.maybe_start(0, 100)
+
+    def test_unreachable_windows_reported(self, tmp_path, profiler_spy):
+        # a spec whose epoch is never visited (resume) or whose start
+        # step exceeds the epoch length stays pending; unfired() is
+        # what fit() warns from at run end
+        cap = TraceCapture(str(tmp_path / "tr"), [(3, 0, 5), (0, 500, 5)])
+        for step in range(10):  # a 10-step epoch 0; epoch 3 never runs
+            assert not cap.maybe_start(0, step)
+        assert sorted(cap.unfired()) == [(0, 500, 5), (3, 0, 5)]
+        assert profiler_spy == {"start": 0, "stop": 0}
+
+
+class TestMemoryEvents:
+    def test_fit_emits_memory_events(self, telemetry_run):
+        """The synthetic-fit harness emits the memory schema at both
+        poll points (post-compile + epoch boundary); on backends
+        without allocator stats (CPU) the event still lands with
+        available=false so downstream tooling sees one schema."""
+        mems = read_events(telemetry_run["run_dir"], "memory")
+        phases = [m["phase"] for m in mems]
+        assert "post_compile" in phases and "epoch" in phases
+        for m in mems:
+            assert set(m) >= {"t", "kind", "phase", "available",
+                              "devices", "peak_bytes", "limit_bytes"}
+            assert isinstance(m["available"], bool)
+            assert isinstance(m["devices"], list)
+            if not m["available"]:
+                assert m["peak_bytes"] is None
+            for row in m["devices"]:
+                assert "device" in row and "peak_bytes_in_use" in row
+
+    def test_emit_memory_event_with_stats(self, tmp_path):
+        from bdbnn_tpu.obs.memory import emit_memory_event
+
+        class FakeDev:
+            def __init__(self, i, peak):
+                self.id = i
+                self._peak = peak
+
+            def memory_stats(self):
+                return {"bytes_in_use": 100, "peak_bytes_in_use": self._peak,
+                        "bytes_limit": 1000}
+
+        ev = EventWriter(str(tmp_path))
+        rec = emit_memory_event(
+            ev, "epoch", [FakeDev(0, 700), FakeDev(1, 800)], epoch=3
+        )
+        ev.close()
+        assert rec["available"] is True
+        assert rec["peak_bytes"] == 800  # max over devices
+        assert rec["limit_bytes"] == 1000
+        assert rec["epoch"] == 3
+        assert len(rec["devices"]) == 2
+
+    def test_hbm_watermark_fold(self):
+        from bdbnn_tpu.obs.memory import hbm_watermark
+
+        evs = [
+            {"kind": "memory", "peak_bytes": 6 * 2**30,
+             "limit_bytes": 16 * 2**30},
+            {"kind": "memory", "peak_bytes": 8 * 2**30,
+             "limit_bytes": 16 * 2**30},
+            {"kind": "memory", "peak_bytes": None, "limit_bytes": None},
+        ]
+        wm = hbm_watermark(evs)
+        assert wm["peak_gib"] == pytest.approx(8.0)
+        assert wm["limit_gib"] == pytest.approx(16.0)
+        assert wm["utilization"] == pytest.approx(0.5)
+        assert hbm_watermark([{"kind": "memory", "peak_bytes": None}]) is None
+
+
+class TestProfileAtEndToEnd:
+    def test_profile_at_capture_and_summarize(self, tmp_path):
+        """--profile-at on a real (CPU) synthetic fit: the window
+        opens/closes exception-free mid-epoch, the trace lands under
+        <run_dir>/profile, the profile event records the window, and
+        `summarize` grows the attribution section."""
+        fit(
+            _cfg(
+                tmp_path,
+                synthetic_train_size=192,  # 3 steps
+                profile_at=("0:1:2",),
+                probe_binarization=False,
+            )
+        )
+        run_dir = _find_run_dir(tmp_path)
+        prof = read_events(run_dir, "profile")
+        assert len(prof) == 1
+        assert prof[0]["epoch"] == 0 and prof[0]["start_step"] == 1
+        assert prof[0]["steps"] == 2
+        from bdbnn_tpu.obs import find_trace_file
+
+        assert find_trace_file(run_dir), "no trace file under run dir"
+
+        report, summary = summarize_run(run_dir)
+        att = summary["attribution"]
+        assert att is not None
+        assert att["captured"]["epoch"] == 0
+        assert att["trace_file"]
+        # the CPU backend strips scope metadata from its op events, so
+        # categories may be all-unattributed here — the span-keyed math
+        # is pinned by TestTraceParser on the synthetic device trace
+        assert isinstance(att["categories_ms_per_step"], dict)
+        assert att["step_total_ms"] is None or att["step_total_ms"] > 0
+        # memory events fold in (CPU: available=false -> no hbm block)
+        assert "hbm" in att or att.get("hbm") is None
+
+
 class TestSummarizeFixture:
     def test_report(self, fixture_run_dir):
         report, summary = summarize_run(fixture_run_dir)
@@ -300,6 +564,26 @@ class TestSummarizeFixture:
         assert summary["loss_components"]["loss_ce"][0] > (
             summary["loss_components"]["loss_ce"][-1]
         )
+
+    def test_attribution_section(self, fixture_run_dir):
+        """The acceptance-criterion path: a run dir with a captured
+        trace window + memory events reports per-category device
+        ms/step keyed by the SEMANTIC span names (not raw HLO names),
+        an MFU, and the HBM peak — in --json and in the report text."""
+        report, summary = summarize_run(fixture_run_dir)
+        att = summary["attribution"]
+        cats = att["categories_ms_per_step"]
+        assert cats["binary_conv"] == pytest.approx(4.0)
+        assert cats["kurtosis_loss"] == pytest.approx(2.0)
+        assert "fusion" not in cats  # semantic names, not HLO names
+        assert att["step_total_ms"] == pytest.approx(10.0)
+        assert att["mfu"] == pytest.approx(0.5)
+        assert att["hbm"]["peak_gib"] == pytest.approx(8.0)
+        assert att["hbm"]["utilization"] == pytest.approx(0.5)
+        assert "device attribution" in report
+        assert "binary_conv" in report
+        assert "MFU 50.0%" in report
+        assert "hbm: peak 8.00 GiB of 16.00 GiB (50%)" in report
 
     def test_probe_fallback_is_chronological(self, fixture_run_dir):
         """Without scalars.jsonl the probe trajectories come from the
