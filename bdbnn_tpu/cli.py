@@ -95,6 +95,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="train on random tensors (smoke/bench only)",
     )
     p.add_argument(
+        "--synthetic-train-size", type=int, default=2048,
+        help="synthetic train examples (with --synthetic)",
+    )
+    p.add_argument(
+        "--synthetic-val-size", type=int, default=512,
+        help="synthetic val examples (with --synthetic)",
+    )
+    p.add_argument(
+        "--save-every-steps", type=int, default=0,
+        help="mid-epoch checkpoint every N completed steps (0 = off; "
+        "step-count keyed, so every pod host saves at the same step)",
+    )
+    p.add_argument(
+        "--save-every-mins", type=float, default=0.0,
+        help="mid-epoch checkpoint every M wallclock minutes (0 = off)",
+    )
+    p.add_argument(
         "--pretrained-path", default="", type=str,
         help="local torch checkpoint backing --pretrained (no egress)",
     )
@@ -228,6 +245,10 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         model_parallel=args.model_parallel,
         distributed_init=args.distributed_init,
         synthetic=args.synthetic,
+        synthetic_train_size=args.synthetic_train_size,
+        synthetic_val_size=args.synthetic_val_size,
+        save_every_steps=args.save_every_steps,
+        save_every_mins=args.save_every_mins,
         pretrained_path=args.pretrained_path,
         dtype=args.dtype,
         device_normalize=args.device_normalize,
@@ -320,8 +341,21 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     from bdbnn_tpu.train.loop import fit
+    from bdbnn_tpu.train.resilience import PREEMPT_EXIT_CODE, PreemptedError
 
-    result = fit(cfg)
+    try:
+        result = fit(cfg)
+    except PreemptedError as e:
+        # the mid-epoch checkpoint already landed (fit saves BEFORE
+        # raising); exit EX_TEMPFAIL so a supervisor restarts the run
+        # with --resume instead of declaring it failed
+        print(
+            f"[bdbnn_tpu] preempted by signal {e.signum} at epoch "
+            f"{e.epoch} step {e.step_in_epoch}; mid-epoch checkpoint "
+            "saved — restart with --resume <run_dir> to continue.",
+            file=sys.stderr,
+        )
+        return PREEMPT_EXIT_CODE
     print(result)
     return 0
 

@@ -67,6 +67,13 @@ class RunConfig:
     log_path: str = "log"
     resume: str = ""
     reset_resume: bool = False
+    # mid-epoch checkpoint cadence (train/resilience.py): save every N
+    # completed steps (deterministic across hosts — pod-safe) and/or
+    # every M wallclock minutes (per-host clock). 0 = epoch-end saves
+    # only. Either way SIGTERM/SIGINT always triggers a final mid-epoch
+    # checkpoint before exiting with the preempt code (75).
+    save_every_steps: int = 0
+    save_every_mins: float = 0.0
     evaluate: bool = False
     seed: Optional[int] = None
     # EDE
@@ -168,6 +175,11 @@ class RunConfig:
 
             for spec in self.profile_at:
                 parse_profile_at(spec, default_steps=self.profile_steps)
+        if self.save_every_steps < 0 or self.save_every_mins < 0:
+            raise ValueError(
+                "--save-every-steps / --save-every-mins must be >= 0 "
+                "(0 disables the cadence)"
+            )
         if not 0.0 <= self.target_acc < 100.0:
             raise ValueError(
                 f"target_acc is a top-1 PERCENTAGE in [0, 100), got "

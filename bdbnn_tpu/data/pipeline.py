@@ -227,7 +227,9 @@ class Pipeline:
     def image_shape(self) -> Tuple[int, int, int]:
         return tuple(self.ds.images.shape[1:])
 
-    def _epoch_batches(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def _epoch_batches(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         idx = host_shard_indices(
             len(self.ds),
             epoch,
@@ -237,21 +239,35 @@ class Pipeline:
             num_hosts=self.num_hosts,
             drop_remainder_to=self.batch_size if self.train else None,
         )
-        rng = np.random.default_rng((self.seed, epoch, self.host_id, 1))
-        for start in range(0, len(idx), self.batch_size):
+        # augment RNG is derived PER BATCH from (seed, epoch, host, batch
+        # index) — not one sequential stream — so a resumed epoch
+        # (start_step > 0) skips straight to batch k without replaying
+        # the augmentation draws of batches it never yields, and the
+        # resumed tail is bit-identical to an uninterrupted epoch's
+        for bi in range(start_step, (len(idx) + self.batch_size - 1) // self.batch_size):
+            start = bi * self.batch_size
             sel = idx[start : start + self.batch_size]
+            rng = np.random.default_rng(
+                (self.seed, epoch, self.host_id, 1, bi)
+            )
             yield self.transform(self.ds.images[sel], rng), self.ds.labels[sel]
 
-    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def epoch(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Batches of ``epoch``, starting at batch ``start_step`` (the
+        mid-epoch resume cursor: a checkpoint taken after step k-1
+        resumes with ``start_step=k`` and sees exactly the batches an
+        uninterrupted run would have seen)."""
         if self.prefetch <= 0:
-            yield from self._epoch_batches(epoch)
+            yield from self._epoch_batches(epoch, start_step)
             return
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
 
         def worker():
             try:
-                for item in self._epoch_batches(epoch):
+                for item in self._epoch_batches(epoch, start_step):
                     q.put(item)
             finally:
                 q.put(sentinel)
@@ -298,6 +314,13 @@ class ImageFolderPipeline:
         self.num_threads = num_threads
         # True: yield raw uint8; the jitted step normalizes on device
         self.device_normalize = device_normalize
+        # graceful decode degradation (_load_one): errors recorded here
+        # by worker threads, drained between batches on the consumer
+        # thread and relayed to on_data_error (the train loop points it
+        # at the events channel -> `data_error` events)
+        self.on_data_error = None
+        self._data_errors: list = []
+        self._errors_lock = threading.Lock()
 
     def steps_per_epoch(self) -> int:
         per_host = len(self.folder) // self.num_hosts
@@ -314,7 +337,10 @@ class ImageFolderPipeline:
     def image_shape(self) -> Tuple[int, int, int]:
         return (self.image_size, self.image_size, 3)
 
-    def _load_one(self, index: int, rng: np.random.Generator) -> np.ndarray:
+    # decode attempts per sample before substituting a neighbor
+    LOAD_RETRIES = 2
+
+    def _decode_one(self, index: int, rng: np.random.Generator):
         im, label = self.folder.load(index)
         if self.train:
             im = random_resized_crop(im, rng, self.image_size)
@@ -326,7 +352,57 @@ class ImageFolderPipeline:
             arr = np.asarray(im, np.uint8)
         return arr, label
 
-    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def _load_one(self, index: int, rng: np.random.Generator):
+        """Decode + augment ``index``; on persistent decode failure
+        (corrupt/truncated file, transient FS error) substitute the
+        nearest decodable neighbor instead of killing the run — one bad
+        image out of 1.3M must cost one ``data_error`` event, not the
+        epoch. The substitute is deterministic (next index mod N), so
+        restarts and multi-host runs stay reproducible."""
+        last_err = None
+        for _ in range(self.LOAD_RETRIES + 1):
+            try:
+                return self._decode_one(index, rng)
+            except (OSError, ValueError, SyntaxError) as e:
+                # PIL raises OSError for truncated files, ValueError /
+                # SyntaxError (broken PNG headers) for malformed ones
+                last_err = e
+        n = len(self.folder)
+        for offset in range(1, n):
+            sub = (index + offset) % n
+            try:
+                arr, label = self._decode_one(sub, rng)
+            except (OSError, ValueError, SyntaxError):
+                continue
+            self._record_data_error(index, sub, last_err)
+            return arr, label
+        raise last_err  # nothing in the dataset decodes
+
+    def _record_data_error(self, index: int, substitute: int, err) -> None:
+        info = {
+            "index": int(index),
+            "substitute": int(substitute),
+            "path": self.folder.samples[index][0],
+            "error": f"{type(err).__name__}: {err}"[:200],
+        }
+        with self._errors_lock:
+            self._data_errors.append(info)
+
+    def _drain_data_errors(self) -> list:
+        with self._errors_lock:
+            out, self._data_errors = self._data_errors, []
+        return out
+
+    def _relay_data_errors(self) -> None:
+        """Relay recorded decode errors to ``on_data_error`` from the
+        CONSUMER thread (the event channel is single-writer)."""
+        for info in self._drain_data_errors():
+            if self.on_data_error is not None:
+                self.on_data_error(info)
+
+    def epoch(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         from concurrent.futures import ThreadPoolExecutor
 
         idx = host_shard_indices(
@@ -338,21 +414,30 @@ class ImageFolderPipeline:
             num_hosts=self.num_hosts,
             drop_remainder_to=self.batch_size if self.train else None,
         )
-        rng = np.random.default_rng((self.seed, epoch, self.host_id))
+        # per-sample augment seeds drawn ONCE for the whole epoch, then
+        # sliced per batch: a resumed epoch (start_step > 0) hands batch
+        # k exactly the seeds it would have gotten uninterrupted,
+        # without replaying draws for batches 0..k-1
+        seeds = np.random.default_rng(
+            (self.seed, epoch, self.host_id)
+        ).integers(0, 2**31, size=len(idx))
         with ThreadPoolExecutor(self.num_threads) as pool:
-            for start in range(0, len(idx), self.batch_size):
+            for start in range(
+                start_step * self.batch_size, len(idx), self.batch_size
+            ):
                 sel = idx[start : start + self.batch_size]
-                seeds = rng.integers(0, 2**31, size=len(sel))
+                bseeds = seeds[start : start + self.batch_size]
                 results = list(
                     pool.map(
                         lambda a: self._load_one(
                             int(a[0]), np.random.default_rng(int(a[1]))
                         ),
-                        zip(sel, seeds),
+                        zip(sel, bseeds),
                     )
                 )
                 images = np.stack([r[0] for r in results])
                 labels = np.array([r[1] for r in results], np.int64)
+                self._relay_data_errors()
                 if self.device_normalize:
                     yield images, labels
                 else:
@@ -380,33 +465,76 @@ def _mp_init(folder, train, image_size, seed):
     _MP_SEED = seed
 
 
+def _mp_decode_one(i: int, rng: np.random.Generator, size: int):
+    im, label = _MP_FOLDER.load(int(i))
+    if _MP_TRAIN:
+        im = random_resized_crop(im, rng, size)
+        arr = np.asarray(im, np.uint8)
+        if rng.random() < 0.5:
+            arr = arr[:, ::-1]
+    else:
+        arr = np.asarray(center_crop(resize_short(im, 256), size), np.uint8)
+    return arr, label
+
+
+# decode attempts per sample before substituting a neighbor (mirrors
+# ImageFolderPipeline.LOAD_RETRIES — the thread-backend twin)
+_MP_LOAD_RETRIES = 2
+
+
 def _mp_build_batch(task):
     """Decode + augment one whole batch inside a worker process.
 
-    Returns uint8 NHWC (4x smaller than float32 over the result pipe;
-    the parent normalizes vectorized). Augment rng is derived from
-    (seed, epoch, sample index), so results are bit-identical for any
-    worker count or assignment.
+    Returns ``(uint8 NHWC, labels, errors)`` (uint8 is 4x smaller than
+    float32 over the result pipe; the parent normalizes vectorized).
+    Augment rng is derived from (seed, epoch, sample index), so results
+    are bit-identical for any worker count or assignment.
+
+    Graceful degradation (same contract as
+    ``ImageFolderPipeline._load_one``): a corrupt/undecodable sample is
+    retried, then the nearest decodable neighbor is substituted (with
+    the ORIGINAL sample's rng, so the stream stays deterministic) and
+    the error travels back to the parent in ``errors`` for the
+    ``data_error`` event channel — one bad file must not kill a pod
+    worker's whole batch.
     """
     epoch, indices = task
     size = _MP_IMAGE_SIZE
+    n = len(_MP_FOLDER)
     images = np.empty((len(indices), size, size, 3), np.uint8)
     labels = np.empty((len(indices),), np.int64)
+    errors = []
     for j, i in enumerate(indices):
         rng = np.random.default_rng(
             np.random.SeedSequence(entropy=(_MP_SEED, epoch, int(i)))
         )
-        im, label = _MP_FOLDER.load(int(i))
-        if _MP_TRAIN:
-            im = random_resized_crop(im, rng, size)
-            arr = np.asarray(im, np.uint8)
-            if rng.random() < 0.5:
-                arr = arr[:, ::-1]
-        else:
-            arr = np.asarray(center_crop(resize_short(im, 256), size), np.uint8)
+        last_err = None
+        arr = label = None
+        for _ in range(_MP_LOAD_RETRIES + 1):
+            try:
+                arr, label = _mp_decode_one(i, rng, size)
+                break
+            except (OSError, ValueError, SyntaxError) as e:
+                last_err = e
+        if arr is None:
+            for offset in range(1, n):
+                sub = (int(i) + offset) % n
+                try:
+                    arr, label = _mp_decode_one(sub, rng, size)
+                except (OSError, ValueError, SyntaxError):
+                    continue
+                errors.append({
+                    "index": int(i),
+                    "substitute": sub,
+                    "path": _MP_FOLDER.samples[int(i)][0],
+                    "error": f"{type(last_err).__name__}: {last_err}"[:200],
+                })
+                break
+            else:
+                raise last_err  # nothing in the dataset decodes
         images[j] = arr
         labels[j] = label
-    return images, labels
+    return images, labels, errors
 
 
 _TF_AVAILABLE = None
@@ -579,7 +707,7 @@ class TFDataImageFolderPipeline(ImageFolderPipeline):
             )
         return img, label
 
-    def _dataset(self, epoch: int):
+    def _dataset(self, epoch: int, start_step: int = 0):
         tf = _import_tf()
         if self._tables is None:
             self._tables = (
@@ -608,6 +736,12 @@ class TFDataImageFolderPipeline(ImageFolderPipeline):
             drop_remainder_to=self.batch_size if self.train else None,
         )
         seeds = _stateless_seeds(self.seed, epoch, idx)
+        if start_step:
+            # stateless per-sample seeds are keyed by GLOBAL index, so
+            # slicing the (index, seed) stream at the resume cursor
+            # reproduces the uninterrupted tail exactly
+            idx = idx[start_step * self.batch_size:]
+            seeds = seeds[start_step * self.batch_size:]
         ds = tf.data.Dataset.from_tensor_slices(
             (idx.astype(np.int64), seeds)
         )
@@ -624,8 +758,11 @@ class TFDataImageFolderPipeline(ImageFolderPipeline):
             ds = ds.with_options(opts)
         return ds
 
-    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        for images, labels in self._dataset(epoch).as_numpy_iterator():
+    def epoch(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        it = self._dataset(epoch, start_step).as_numpy_iterator()
+        for images, labels in it:
             yield images, labels
 
 
@@ -713,7 +850,9 @@ class MPImageFolderPipeline(ImageFolderPipeline):
         except Exception:
             pass
 
-    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def epoch(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         idx = host_shard_indices(
             len(self.folder),
             epoch,
@@ -723,9 +862,13 @@ class MPImageFolderPipeline(ImageFolderPipeline):
             num_hosts=self.num_hosts,
             drop_remainder_to=self.batch_size if self.train else None,
         )
+        # worker augment RNG is keyed by (seed, epoch, sample index) —
+        # skipping the first start_step batch tasks replays nothing
         tasks = (
             (epoch, idx[s : s + self.batch_size].tolist())
-            for s in range(0, len(idx), self.batch_size)
+            for s in range(
+                start_step * self.batch_size, len(idx), self.batch_size
+            )
         )
         pool = self._get_pool()
         window: deque = deque()
@@ -733,7 +876,7 @@ class MPImageFolderPipeline(ImageFolderPipeline):
             window.append(pool.apply_async(_mp_build_batch, (t,)))
         while window:
             try:
-                images_u8, labels = window.popleft().get(
+                images_u8, labels, errors = window.popleft().get(
                     timeout=self.RESULT_TIMEOUT_S
                 )
             except multiprocessing.TimeoutError:
@@ -743,6 +886,11 @@ class MPImageFolderPipeline(ImageFolderPipeline):
                     f"{self.RESULT_TIMEOUT_S:.0f}s — a decode worker "
                     "likely died (OOM-killed?); pool terminated"
                 ) from None
+            # worker-side substitutions surface on the CONSUMER thread
+            # (the event channel is single-writer)
+            for err in errors:
+                if self.on_data_error is not None:
+                    self.on_data_error(err)
             nxt = next(tasks, None)
             if nxt is not None:
                 window.append(pool.apply_async(_mp_build_batch, (nxt,)))

@@ -100,6 +100,11 @@ def cifar_model_factories(num_classes: int = 10) -> Dict[str, Callable]:
         # binary (EDE-able plain-STE CIFAR convs, hardtanh blocks)
         "resnet18": f(_make_cifar, "resnet18", (2, 2, 2, 2), 64, "cifar", "hardtanh", num_classes),
         "resnet20": f(_make_cifar, "resnet20", (3, 3, 3), 16, "cifar", "hardtanh", num_classes),
+        # 2-stage width-8 twig: compiles in seconds on a CPU backend —
+        # the smoke/fault-injection arch (tests/test_faults.py launches
+        # whole training subprocesses around it), NOT an acceptance
+        # config
+        "resnet8_tiny": f(_make_cifar, "resnet8_tiny", (1, 1), 8, "cifar", "hardtanh", num_classes),
         "resnet34": f(_make_cifar, "resnet34", (3, 4, 6, 3), 64, "cifar", "hardtanh", num_classes),
         # react-style CIFAR (RSign/RPReLU)
         "resnet18_react": f(_make_cifar, "resnet18_react", (2, 2, 2, 2), 64, "react", "rprelu", num_classes),

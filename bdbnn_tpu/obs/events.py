@@ -21,6 +21,18 @@ Event kinds emitted by ``fit()``:
 - ``profile``     — a trace capture window closed (epoch, start_step,
   steps, trace_dir) — `summarize` keys its attribution section on it
 - ``memory``      — HBM watermark poll (obs/memory.py)
+- ``checkpoint``  — a checkpoint committed (epoch-end, step/wallclock
+  interval, or preemption), with the schedule state it froze (LR step,
+  EDE t/k, kurtosis gate) — the fault-injection tests compare these
+  against the resumed run's ``restore`` event bitwise
+- ``restore``     — a resume restored state: source dir, integrity
+  verdict, ``fallback`` (checkpoint.old used), what was and wasn't
+  restored, and the resume-point schedule state
+- ``preempt``     — SIGTERM/SIGINT latched and the mid-epoch
+  checkpoint landed; the process exits with the preempt code next
+- ``data_error``  — a corrupt/undecodable sample was substituted
+  (graceful input degradation, data/pipeline.py) instead of killing
+  the run
 - ``run_end``     — best acc/epoch, total wall seconds
 
 ``bench.py`` adds ``bench_result`` records with the same envelope.
@@ -53,6 +65,10 @@ KNOWN_KINDS = frozenset(
         "nonfinite",
         "profile",
         "memory",
+        "checkpoint",
+        "restore",
+        "preempt",
+        "data_error",
         "run_end",
         "bench_result",
     }

@@ -16,6 +16,11 @@ debugging a finished BNN run actually asks:
   ``--profile-at`` trace window and/or ``memory`` events: per-semantic-
   category device ms/step from the span-annotated trace, an MFU
   estimate, and the run-wide HBM peak against the device limit)
+- is the run preemption-safe, and what is its restart history? (the
+  "resilience" section: checkpoint cadence + age of the last
+  checkpoint, restart lineage from the manifest, restore provenance
+  incl. ``checkpoint.old`` fallbacks, preemptions, substituted
+  corrupt samples)
 
 Stdlib-only: summarizing a run must never initialize a JAX backend.
 """
@@ -227,6 +232,44 @@ def _attribution(run_dir, manifest, events) -> Optional[Dict[str, Any]]:
     return out
 
 
+def _resilience(manifest, events) -> Dict[str, Any]:
+    """Checkpoint/restart posture: how much work a preemption would
+    cost right now, and how this run relates to its ancestors."""
+    ckpts = [e for e in events if e.get("kind") == "checkpoint"]
+    restores = [e for e in events if e.get("kind") == "restore"]
+    preempts = [e for e in events if e.get("kind") == "preempt"]
+    data_errors = [e for e in events if e.get("kind") == "data_error"]
+    lineage = list((manifest or {}).get("restart_lineage") or [])
+    last_age = None
+    if ckpts and events:
+        # age of the newest checkpoint at the run's last sign of life —
+        # the work a preemption at that moment would have thrown away
+        last_age = round(float(events[-1]["t"]) - float(ckpts[-1]["t"]), 1)
+    return {
+        "checkpoints": len(ckpts),
+        "mid_epoch_checkpoints": sum(
+            1 for e in ckpts if e.get("step_in_epoch")
+        ),
+        "last_checkpoint_age_s": last_age,
+        "restart_count": len(lineage),
+        "resumed_from": (manifest or {}).get("resumed_from"),
+        "restart_lineage": lineage,
+        "restores": [
+            {
+                k: r.get(k)
+                for k in ("source", "fallback", "integrity", "epoch",
+                          "step_in_epoch")
+            }
+            for r in restores
+        ],
+        "preempts": [
+            {k: p.get(k) for k in ("signum", "epoch", "step_in_epoch")}
+            for p in preempts
+        ],
+        "data_errors": len(data_errors),
+    }
+
+
 def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     """Returns ``(report_text, summary_dict)`` for a run directory."""
     run_dir = resolve_run_dir(path)
@@ -276,6 +319,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
 
     probes = _probe_trajectories(scalars, events)
     attribution = _attribution(run_dir, manifest, events)
+    resilience = _resilience(manifest, events)
 
     summary: Dict[str, Any] = {
         "run_dir": run_dir,
@@ -301,6 +345,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
         "loss_components": components,
         "probes": probes,
         "attribution": attribution,
+        "resilience": resilience,
         "nonfinite_intervals": len(nonfinite),
     }
     # strict JSON out the other end too: a warn-policy run's NaN
@@ -394,6 +439,49 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                 )
             else:
                 lines.append(f"hbm: peak {hbm['peak_gib']:.2f} GiB")
+    res = resilience
+    if (
+        res["checkpoints"]
+        or res["restart_count"]
+        or res["restores"]
+        or res["preempts"]
+        or res["data_errors"]
+    ):
+        parts = []
+        if res["checkpoints"]:
+            mid = res["mid_epoch_checkpoints"]
+            parts.append(
+                f"{res['checkpoints']} checkpoint(s)"
+                + (f" ({mid} mid-epoch)" if mid else "")
+                + (
+                    f", last {res['last_checkpoint_age_s']:.0f}s before "
+                    "the run's last event"
+                    if res["last_checkpoint_age_s"] is not None
+                    else ""
+                )
+            )
+        if res["restart_count"]:
+            parts.append(f"restart #{res['restart_count']} in lineage")
+        lines.append("resilience: " + ("  ".join(parts) or "events only"))
+        for r in res["restores"]:
+            lines.append(
+                f"  restored from {r.get('source')} (epoch "
+                f"{r.get('epoch')} step {r.get('step_in_epoch')}, "
+                f"integrity {r.get('integrity')}"
+                + (", FELL BACK to checkpoint.old" if r.get("fallback") else "")
+                + ")"
+            )
+        for p in res["preempts"]:
+            lines.append(
+                f"  preempted by signal {p.get('signum')} at epoch "
+                f"{p.get('epoch')} step {p.get('step_in_epoch')} "
+                "(mid-epoch checkpoint saved)"
+            )
+        if res["data_errors"]:
+            lines.append(
+                f"  !! {res['data_errors']} corrupt sample(s) substituted "
+                "(data_error events)"
+            )
     if probes:
         lines.append(
             "binarization probes (per-layer, first -> last interval/epoch):"

@@ -3,10 +3,12 @@
 ``python -m bdbnn_tpu.cli watch RUN_DIR [--interval S] [--once]``
 tails ``events.jsonl`` and re-renders a compact status block whenever
 the file grows: current epoch/step, last eval accuracy, flip-rate
-drift, the input-starvation flag, non-finite incidents, and the final
-verdict once ``run_end`` lands. Where ``summarize`` is the post-mortem,
-``watch`` is the heartbeat — same files, no JAX backend, so it can run
-on a laptop against a pod run's synced log dir.
+drift, the input-starvation flag, non-finite incidents, checkpoint
+freshness (seconds since the last committed checkpoint — the work a
+preemption RIGHT NOW would throw away — plus the run's restart count),
+and the final verdict once ``run_end`` lands. Where ``summarize`` is
+the post-mortem, ``watch`` is the heartbeat — same files, no JAX
+backend, so it can run on a laptop against a pod run's synced log dir.
 
 Stdlib-only (obs-package rule).
 """
@@ -18,6 +20,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from bdbnn_tpu.obs.events import EVENTS_NAME, read_events
+from bdbnn_tpu.obs.manifest import read_manifest
 from bdbnn_tpu.obs.summarize import INPUT_BOUND_SHARE
 
 
@@ -25,8 +28,12 @@ def _mean(vals: List[float]) -> Optional[float]:
     return sum(vals) / len(vals) if vals else None
 
 
-def render_status(events: List[Dict[str, Any]]) -> str:
-    """The status block for one snapshot of a run's event timeline."""
+def render_status(
+    events: List[Dict[str, Any]],
+    manifest: Optional[Dict[str, Any]] = None,
+) -> str:
+    """The status block for one snapshot of a run's event timeline
+    (``manifest`` adds the restart count when available)."""
     if not events:
         return "(no events yet)"
     start = next((e for e in events if e.get("kind") == "run_start"), None)
@@ -35,6 +42,10 @@ def render_status(events: List[Dict[str, Any]]) -> str:
     nonfinite = [e for e in events if e.get("kind") == "nonfinite"]
     end = next((e for e in events if e.get("kind") == "run_end"), None)
     memory = [e for e in events if e.get("kind") == "memory"]
+    ckpts = [e for e in events if e.get("kind") == "checkpoint"]
+    preempts = [e for e in events if e.get("kind") == "preempt"]
+    data_errors = [e for e in events if e.get("kind") == "data_error"]
+    restarts = len((manifest or {}).get("restart_lineage") or [])
 
     lines = []
     if start:
@@ -42,6 +53,7 @@ def render_status(events: List[Dict[str, Any]]) -> str:
             f"run: epochs {start.get('start_epoch', 0)}->"
             f"{start.get('epochs')} | {start.get('steps_per_epoch')} "
             f"steps/epoch | config {start.get('config_hash', '?')}"
+            + (f" | restart #{restarts}" if restarts else "")
         )
     last = intervals[-1] if intervals else None
     if last:
@@ -79,6 +91,30 @@ def render_status(events: List[Dict[str, Any]]) -> str:
         peaks = [e.get("peak_bytes") for e in memory if e.get("peak_bytes")]
         if peaks:
             lines.append(f"hbm:   peak {max(peaks) / 2**30:.2f} GiB")
+    # checkpoint freshness: the at-a-glance answer to "is this run
+    # preemption-safe right now, and how much work would a kill cost?"
+    if ckpts:
+        c = ckpts[-1]
+        if end is not None:
+            age_txt = "final"
+        else:
+            age_txt = f"{time.time() - float(c.get('t', 0.0)):.0f}s ago"
+        lines.append(
+            f"ckpt:  last saved {age_txt} (reason {c.get('reason')}, "
+            f"epoch {c.get('epoch')} step {c.get('step_in_epoch')}, "
+            f"{len(ckpts)} total)"
+        )
+    elif start and end is None:
+        lines.append("ckpt:  NONE yet — a preemption now loses everything")
+    if preempts:
+        p = preempts[-1]
+        lines.append(
+            f"!! preempted (signal {p.get('signum')}) at epoch "
+            f"{p.get('epoch')} step {p.get('step_in_epoch')} — resume "
+            "with --resume"
+        )
+    if data_errors:
+        lines.append(f"!! corrupt samples substituted: {len(data_errors)}")
     if nonfinite:
         lines.append(f"!! non-finite incidents: {len(nonfinite)}")
     if end:
@@ -101,16 +137,17 @@ def watch_run(
     path = os.path.join(run_dir, EVENTS_NAME)
     last_size = -1
     while True:
+        manifest = read_manifest(run_dir)
         size = os.path.getsize(path) if os.path.exists(path) else 0
         if size != last_size:
             last_size = size
             events = read_events(run_dir)
-            out(render_status(events))
+            out(render_status(events, manifest))
             if once or any(e.get("kind") == "run_end" for e in events):
                 return 0
             out("---")
         elif once:
-            out(render_status(read_events(run_dir)))
+            out(render_status(read_events(run_dir), manifest))
             return 0
         try:
             time.sleep(interval)

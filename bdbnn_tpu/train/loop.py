@@ -25,7 +25,7 @@ import contextlib
 import dataclasses
 import os
 import time
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,7 @@ from bdbnn_tpu.obs import (
     TraceCapture,
     emit_memory_event,
     parse_profile_at,
+    read_manifest,
     write_manifest,
 )
 from bdbnn_tpu.obs.probes import NonFiniteLossError, drain_probe_report
@@ -71,6 +72,11 @@ from bdbnn_tpu.parallel import (
 )
 from bdbnn_tpu.train.ede import cpt_tk
 from bdbnn_tpu.train.optim import make_optimizer
+from bdbnn_tpu.train.resilience import (
+    CheckpointPolicy,
+    PreemptedError,
+    PreemptionHandler,
+)
 from bdbnn_tpu.train.state import StepConfig, TrainState
 from bdbnn_tpu.train.step import (
     make_eval_step,
@@ -370,6 +376,109 @@ def build_teacher(cfg: RunConfig, image_size: int):
     return teacher, variables
 
 
+def _pack_host_rng() -> Dict:
+    """The legacy np.random global state as strict-JSON scalars (the
+    ``resume.json`` sidecar carries it; ~4KB)."""
+    name, keys, pos, has_gauss, cached = np.random.get_state(legacy=True)
+    return {
+        "name": name,
+        "keys": [int(x) for x in keys],
+        "pos": int(pos),
+        "has_gauss": int(has_gauss),
+        "cached_gaussian": float(cached),
+    }
+
+
+def _unpack_host_rng(d: Dict) -> None:
+    np.random.set_state(
+        (
+            d["name"],
+            np.asarray(d["keys"], dtype=np.uint32),
+            int(d["pos"]),
+            int(d["has_gauss"]),
+            float(d["cached_gaussian"]),
+        )
+    )
+
+
+def _resume_lineage(resume_path: str) -> Dict:
+    """Manifest extras recording restart ancestry: ``resumed_from`` (the
+    --resume argument) and ``restart_lineage`` (every prior run dir in
+    the chain, oldest first — carried forward from the prior run's own
+    manifest, so a thrice-preempted run lists all three ancestors)."""
+    if not resume_path:
+        return {}
+    prior_dir = resume_path
+    if os.path.isfile(prior_dir):  # a torch .pth file
+        prior_dir = os.path.dirname(prior_dir) or "."
+    prior = None
+    # the manifest lives in the run dir — which is either the --resume
+    # path itself or its parent (--resume pointing at checkpoint/)
+    for cand in (prior_dir, os.path.dirname(prior_dir.rstrip(os.sep))):
+        if cand:
+            m = read_manifest(cand)
+            if m is not None:
+                prior, prior_dir = m, cand
+                break
+    lineage = list((prior or {}).get("restart_lineage") or [])
+    lineage.append(os.path.abspath(prior_dir))
+    return {
+        "resumed_from": os.path.abspath(resume_path),
+        "restart_lineage": lineage,
+    }
+
+
+@dataclasses.dataclass
+class _Resilience:
+    """fit()-scoped preemption/cadence bundle threaded into the epoch
+    loop. ``save`` is a closure over fit's checkpoint bookkeeping:
+    ``save(state, epoch, step_in_epoch, reason)`` commits a checkpoint
+    + emits the ``checkpoint`` event + resets the cadence.
+
+    ``collective`` (multi-process run): flag-triggered saves are
+    SKIPPED — the preemption flag latches at a different step on each
+    host, and the collective Orbax save would either hang on its
+    barriers or mix shards from different steps. Pods rely on the
+    step-count-keyed ``--save-every-steps`` cadence (deterministic, so
+    every host saves at the same step) for mid-epoch durability."""
+
+    handler: PreemptionHandler
+    policy: CheckpointPolicy
+    save: Any
+    events: EventWriter
+    collective: bool = False
+
+    def preempt_exit(
+        self, state, epoch: int, step_in_epoch: int,
+        already_durable: bool = False,
+    ) -> None:
+        """The preemption exit protocol: make the state durable (unless
+        a checkpoint of exactly this state just committed, or the save
+        would be an unaligned collective), emit ``preempt``, raise."""
+        target_epoch = epoch if step_in_epoch else epoch + 1
+        saved = already_durable
+        if not already_durable and not self.collective:
+            self.save(state, epoch, step_in_epoch, "preempt")
+            saved = True
+        self.events.emit(
+            "preempt",
+            signum=self.handler.signum,
+            epoch=target_epoch,
+            step_in_epoch=step_in_epoch,
+            saved=saved,
+        )
+        raise PreemptedError(self.handler.signum, target_epoch, step_in_epoch)
+
+    def after_step(self, state, epoch: int, next_step: int) -> None:
+        """Called at each step boundary (state consistent, saveable).
+        Preemption → final mid-epoch checkpoint, ``preempt`` event,
+        raise; cadence due → mid-epoch checkpoint and continue."""
+        if self.handler.preempted:
+            self.preempt_exit(state, epoch, next_step)
+        if self.policy.active and self.policy.step():
+            self.save(state, epoch, next_step, "interval")
+
+
 def fit(cfg: RunConfig) -> Dict[str, float]:
     """End-to-end training (↔ ``main_worker`` + epoch loop)."""
     resources: list = []
@@ -399,7 +508,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     # unified telemetry: provenance manifest + structured event channel
     # live next to log.txt/scalars.jsonl from the first moment of the
     # run, so even a crashed run is diagnosable post hoc (`summarize`)
-    manifest = write_manifest(log_path, cfg)
+    # — including restart ancestry when this run resumes another
+    manifest = write_manifest(log_path, cfg, extra=_resume_lineage(cfg.resume))
     events = EventWriter(log_path)
     _resources.append(events)
     logger.info(
@@ -412,6 +522,12 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
 
     train_pipe, val_pipe, image_size = build_datasets(cfg)
     _resources.extend((train_pipe, val_pipe))
+    if hasattr(val_pipe, "on_data_error"):
+        # eval-side graceful degradation reports too (train-side wiring
+        # happens per epoch in _train_epoch, where the epoch is known)
+        val_pipe.on_data_error = lambda info: events.emit(
+            "data_error", where="eval", **info
+        )
     steps_per_epoch = max(train_pipe.steps_per_epoch(), 1)
 
     mesh = make_mesh(model_parallel=cfg.model_parallel)
@@ -590,8 +706,20 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     # the jitted eval step would retrace per dtype
     eval_fill_dtype = np.uint8 if cfg.device_normalize else np.float32
 
+    def _sched(epoch):
+        """Schedule state entering ``epoch`` — the exact scalars the
+        first step of that epoch will be fed. Recorded in `checkpoint`
+        events at save time and in the `restore` event at resume time,
+        so the fault-injection tests can assert the resume point's EDE
+        (t, k) and kurtosis gate are bitwise-identical to what the
+        interrupted run would have used."""
+        t, k = cpt_tk(epoch, cfg.epochs) if cfg.ede else (1.0, 1.0)
+        gate = 1.0 if epoch >= cfg.kurtepoch else 0.0
+        return float(t), float(k), float(gate)
+
     best_acc1, best_epoch = 0.0, -1
     start_epoch = cfg.start_epoch
+    start_step = 0
     if cfg.resume:
         if cfg.resume.endswith((".pth", ".pth.tar", ".pt")):
             # reference-format torch student checkpoint (train.py:346-366)
@@ -647,10 +775,53 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                         state.opt_state, resume_step
                     ),
                 )
+                # the EDE anneal and kurtosis gate are epoch-keyed, so
+                # fast-forwarding the LR to start_epoch*steps_per_epoch
+                # implies the SAME epoch index feeds cpt_tk — record
+                # that state so schedule consistency is auditable
+                ede_t, ede_k, kurt_gate = _sched(start_epoch)
                 logger.warning(
                     "torch .pth resume: LR schedule fast-forwarded to "
-                    "step %d; Adam moments restart (not translated from "
-                    "torch optimizer state)", resume_step,
+                    "step %d (EDE t=%.6g k=%.6g, kurt gate %.0f at "
+                    "epoch %d); Adam moments restart (not translated "
+                    "from torch optimizer state)",
+                    resume_step, ede_t, ede_k, kurt_gate, start_epoch,
+                )
+                events.emit(
+                    "restore",
+                    source=cfg.resume,
+                    format="torch",
+                    fallback=False,
+                    integrity="missing",
+                    epoch=start_epoch,
+                    step_in_epoch=0,
+                    lr_step=resume_step,
+                    ede_t=ede_t,
+                    ede_k=ede_k,
+                    kurt_gate=kurt_gate,
+                    restored=[
+                        "params", "batch_stats", "epoch", "best_acc1",
+                        "lr_step", "ede_schedule",
+                    ],
+                    not_restored=[
+                        "opt_moments", "step_in_epoch", "host_rng",
+                        "best_epoch",
+                    ],
+                )
+            else:
+                events.emit(
+                    "restore",
+                    source=cfg.resume,
+                    format="torch",
+                    fallback=False,
+                    integrity="missing",
+                    epoch=start_epoch,
+                    step_in_epoch=0,
+                    restored=["params", "batch_stats"],
+                    not_restored=[
+                        "epoch", "best_acc1", "lr_step", "opt_moments",
+                        "step_in_epoch", "host_rng", "best_epoch",
+                    ],
                 )
         else:
             restored = load_checkpoint(
@@ -659,7 +830,44 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             state = restored["state"]
             start_epoch = restored["epoch"]
             best_acc1 = restored["best_acc1"]
-        logger.info("resumed from %s at epoch %d", cfg.resume, start_epoch)
+            best_epoch = restored.get("best_epoch", -1)
+            start_step = restored.get("step_in_epoch", 0)
+            if restored.get("host_rng"):
+                _unpack_host_rng(restored["host_rng"])
+            if restored.get("fallback"):
+                logger.warning(
+                    "committed checkpoint unusable; restored the "
+                    "previous one from %s", restored["source"],
+                )
+            ede_t, ede_k, kurt_gate = _sched(start_epoch)
+            events.emit(
+                "restore",
+                source=restored["source"],
+                format="orbax",
+                fallback=bool(restored.get("fallback")),
+                integrity=restored.get("integrity"),
+                epoch=start_epoch,
+                step_in_epoch=start_step,
+                lr_step=int(jax.device_get(state.step)),
+                ede_t=ede_t,
+                ede_k=ede_k,
+                kurt_gate=kurt_gate,
+                restored=[
+                    "params", "batch_stats", "opt_state", "lr_step",
+                    "epoch", "best_acc1", "best_epoch", "step_in_epoch",
+                    "host_rng",
+                ]
+                if not cfg.reset_resume
+                else ["params", "batch_stats"],
+                not_restored=[] if not cfg.reset_resume else [
+                    "opt_state", "lr_step", "epoch", "best_acc1",
+                    "best_epoch", "step_in_epoch", "host_rng",
+                ],
+            )
+        logger.info(
+            "resumed from %s at epoch %d step %d",
+            cfg.resume, start_epoch, start_step,
+        )
 
     # --profile-at capture windows (arbitrary EPOCH:STEP[:NSTEPS]
     # points); bare --profile-dir keeps its legacy meaning as the
@@ -698,7 +906,7 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     # reported misleadingly small.
     t_fit = time.time()
     time_to_target = None
-    track_target = cfg.target_acc > 0 and start_epoch == 0
+    track_target = cfg.target_acc > 0 and start_epoch == 0 and not cfg.resume
     if cfg.target_acc > 0 and not track_target:
         logger.warning(
             "time-to-target disabled: resumed at epoch %d, pre-resume "
@@ -709,64 +917,138 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         "run_start",
         config_hash=manifest["config_hash"],
         start_epoch=start_epoch,
+        start_step=start_step,
         epochs=cfg.epochs,
         steps_per_epoch=steps_per_epoch,
         probed_layers=list(probe_sizes),
     )
 
-    for epoch in range(start_epoch, cfg.epochs):
-        t, k = cpt_tk(epoch, cfg.epochs) if cfg.ede else (1.0, 1.0)
-        if cfg.ede:
-            # the annealed estimator's schedule, next to grad_norm —
-            # the pair that separates schedule-budget from gradient
-            # starvation when an EDE run stalls (VERDICT r4 weak #5)
-            writer.add_scalar("EDE t", float(t), epoch)
-            writer.add_scalar("EDE k", float(k), epoch)
-        tk = (jnp.float32(t), jnp.float32(k))
-        kurt_gate = jnp.float32(1.0 if epoch >= cfg.kurtepoch else 0.0)
-
-        state = _train_epoch(
-            train_step, state, train_pipe, mesh, epoch, tk, kurt_gate,
-            cfg, steps_per_epoch, logger, writer, obs=obs,
+    every_mins = cfg.save_every_mins
+    if every_mins and jax.process_count() > 1:
+        # per-host wallclocks would make hosts trigger the collective
+        # save at DIFFERENT steps — barrier hang or mixed-step shards
+        logger.warning(
+            "--save-every-mins disabled on multi-process runs (per-host "
+            "clocks desynchronize the collective save); use the "
+            "step-count-keyed --save-every-steps instead"
         )
-        acc1 = _validate(
-            eval_step, state, val_pipe, mesh, logger, writer, epoch,
-            fill_dtype=eval_fill_dtype, events=events,
-            nonfinite_policy=cfg.nonfinite_policy,
+        every_mins = 0.0
+    policy = CheckpointPolicy(cfg.save_every_steps, every_mins)
+
+    def _save_ckpt(st, epoch, step_in_epoch, reason, is_best=False):
+        """Commit a checkpoint (mid-epoch when step_in_epoch > 0) with
+        full resume state, emit the ``checkpoint`` event carrying the
+        schedule scalars the RESUMED run must reproduce bitwise, and
+        reset the cadence."""
+        t0 = time.time()
+        # the epoch the resume will enter: the current one (mid-epoch)
+        # or the next (epoch-end)
+        target_epoch = epoch if step_in_epoch else epoch + 1
+        ede_t, ede_k, kurt_gate = _sched(target_epoch)
+        lr_step = int(jax.device_get(st.step))
+        path = save_checkpoint(
+            log_path, st,
+            epoch=epoch, arch=cfg.arch, best_acc1=best_acc1,
+            is_best=is_best, step_in_epoch=step_in_epoch,
+            resume_state={
+                "best_epoch": int(best_epoch),
+                "host_rng": _pack_host_rng(),
+                "lr_step": lr_step,
+                "ede_t": ede_t,
+                "ede_k": ede_k,
+                "kurt_gate": kurt_gate,
+            },
+        )
+        events.emit(
+            "checkpoint",
+            reason=reason,
+            epoch=target_epoch,
+            step_in_epoch=step_in_epoch,
+            lr_step=lr_step,
+            ede_t=ede_t,
+            ede_k=ede_k,
+            kurt_gate=kurt_gate,
+            path=path,
+            seconds=round(time.time() - t0, 3),
+        )
+        policy.note_saved()
+
+    if start_step >= steps_per_epoch:
+        logger.warning(
+            "resume cursor step %d >= %d steps/epoch (config change "
+            "since the checkpoint?); epoch %d will run no steps",
+            start_step, steps_per_epoch, start_epoch,
         )
 
-        if (
-            time_to_target is None
-            and track_target
-            and acc1 >= cfg.target_acc
-        ):
-            time_to_target = time.time() - t_fit
-            writer.add_scalar("Time to target (s)", time_to_target, epoch)
-            logger.info(
-                " ##### reached target Acc@1 %.2f at epoch %d after %.1fs",
-                cfg.target_acc, epoch, time_to_target,
+    with PreemptionHandler() as handler:
+        resil = _Resilience(
+            handler, policy, _save_ckpt, events,
+            collective=jax.process_count() > 1,
+        )
+        for epoch in range(start_epoch, cfg.epochs):
+            t, k = cpt_tk(epoch, cfg.epochs) if cfg.ede else (1.0, 1.0)
+            if cfg.ede:
+                # the annealed estimator's schedule, next to grad_norm —
+                # the pair that separates schedule-budget from gradient
+                # starvation when an EDE run stalls (VERDICT r4 weak #5)
+                writer.add_scalar("EDE t", float(t), epoch)
+                writer.add_scalar("EDE k", float(k), epoch)
+            tk = (jnp.float32(t), jnp.float32(k))
+            kurt_gate = jnp.float32(1.0 if epoch >= cfg.kurtepoch else 0.0)
+
+            state = _train_epoch(
+                train_step, state, train_pipe, mesh, epoch, tk, kurt_gate,
+                cfg, steps_per_epoch, logger, writer, obs=obs,
+                start_step=start_step if epoch == start_epoch else 0,
+                resil=resil,
+            )
+            if handler.preempted:
+                # the flag landed on the epoch's final step: save NOW,
+                # before validation — at ImageNet scale eval outlasts
+                # the preemption grace period, and SIGKILL mid-eval
+                # would discard the whole epoch
+                resil.preempt_exit(state, epoch, 0)
+            acc1 = _validate(
+                eval_step, state, val_pipe, mesh, logger, writer, epoch,
+                fill_dtype=eval_fill_dtype, events=events,
+                nonfinite_policy=cfg.nonfinite_policy,
             )
 
-        # HBM watermark at the epoch boundary: one cheap allocator
-        # query per device per epoch, no device sync (memory event;
-        # obs/memory.py). The post-compile poll already pinned the
-        # steady-state footprint — these catch drift (fragmentation,
-        # eval-shape growth).
-        emit_memory_event(events, "epoch", jax.local_devices(), epoch=epoch)
+            if (
+                time_to_target is None
+                and track_target
+                and acc1 >= cfg.target_acc
+            ):
+                time_to_target = time.time() - t_fit
+                writer.add_scalar("Time to target (s)", time_to_target, epoch)
+                logger.info(
+                    " ##### reached target Acc@1 %.2f at epoch %d after %.1fs",
+                    cfg.target_acc, epoch, time_to_target,
+                )
 
-        is_best = acc1 > best_acc1
-        if is_best:
-            best_epoch = epoch
-        best_acc1 = max(acc1, best_acc1)
-        writer.add_scalar("Best val Acc1", best_acc1, epoch)
-        logger.info(
-            " ***** Best acc is Acc@1 %.3f, epoch %d, log %s",
-            best_acc1, best_epoch, log_path,
-        )
-        save_checkpoint(
-            log_path, state,
-            epoch=epoch, arch=cfg.arch, best_acc1=best_acc1, is_best=is_best,
-        )
+            # HBM watermark at the epoch boundary: one cheap allocator
+            # query per device per epoch, no device sync (memory event;
+            # obs/memory.py). The post-compile poll already pinned the
+            # steady-state footprint — these catch drift (fragmentation,
+            # eval-shape growth).
+            emit_memory_event(events, "epoch", jax.local_devices(), epoch=epoch)
+
+            is_best = acc1 > best_acc1
+            if is_best:
+                best_epoch = epoch
+            best_acc1 = max(acc1, best_acc1)
+            writer.add_scalar("Best val Acc1", best_acc1, epoch)
+            logger.info(
+                " ***** Best acc is Acc@1 %.3f, epoch %d, log %s",
+                best_acc1, best_epoch, log_path,
+            )
+            _save_ckpt(state, epoch, 0, "epoch", is_best=is_best)
+
+            if handler.preempted:
+                # the signal landed during validation/checkpointing —
+                # the epoch-end checkpoint above is already durable, so
+                # exit the preemption protocol without another save
+                resil.preempt_exit(state, epoch, 0, already_durable=True)
 
     if tracer is not None and tracer.unfired():
         # an unreachable spec (epoch resumed past, start step beyond
@@ -880,7 +1162,7 @@ def _profile_window_done(obs, logger, info):
 
 def _train_epoch(
     train_step, state, pipe, mesh, epoch, tk, kurt_gate, cfg,
-    steps_per_epoch, logger, writer, obs=None,
+    steps_per_epoch, logger, writer, obs=None, start_step=0, resil=None,
 ):
     """One epoch. The hot loop never syncs with the device: metrics go
     into a lazy on-device accumulator and are drained once every
@@ -926,8 +1208,14 @@ def _train_epoch(
         # wall between epochs so it can't dilute the first interval's
         # data-wait share
         timer.reset()
-    it = iter(pipe.epoch(epoch))
-    step_idx = -1
+    if obs is not None and hasattr(pipe, "on_data_error"):
+        # graceful input degradation: a substituted corrupt sample
+        # becomes a `data_error` event instead of a dead run
+        pipe.on_data_error = lambda info: obs.events.emit(
+            "data_error", epoch=epoch, **info
+        )
+    it = iter(pipe.epoch(epoch, start_step))
+    step_idx = start_step - 1
     try:
         while True:
             # the window for the UPCOMING step opens before its data
@@ -956,12 +1244,12 @@ def _train_epoch(
             t_done = time.perf_counter()
             if timer is not None:
                 timer.add("dispatch", t_done - t_mark)
-                if step_idx == 0 and timer.compile_s is None:
+                if step_idx == start_step and timer.compile_s is None:
                     # the process's first call blocks the host on
                     # trace+compile (also when resuming at
-                    # start_epoch>0); subsequent dispatches are sub-ms
-                    # async enqueues, so this host-side duration IS the
-                    # compile cost
+                    # start_epoch>0 or mid-epoch at start_step>0);
+                    # subsequent dispatches are sub-ms async enqueues,
+                    # so this host-side duration IS the compile cost
                     timer.record_compile(t_done - t_mark)
                     obs.events.emit(
                         "compile", seconds=round(t_done - t_mark, 3)
@@ -1006,10 +1294,19 @@ def _train_epoch(
                         f"img/s {rate:8.1f} ({rate / n_chips:7.1f}/chip)",
                     ],
                 )
-                sec_per_step = (time.time() - t_epoch) / max(step_idx + 1, 1)
+                sec_per_step = (time.time() - t_epoch) / max(
+                    step_idx + 1 - start_step, 1
+                )
                 remain_steps = (cfg.epochs - epoch) * steps_per_epoch - step_idx
                 logger.info(">>>>>>>>>>>> Remaining Time: %s <<<<<<<<<<<<",
                             format_eta(remain_steps * sec_per_step))
+            # step boundary: the state is consistent and saveable.
+            # Preemption → mid-epoch checkpoint + `preempt` event +
+            # PreemptedError; --save-every-steps/--save-every-mins due →
+            # mid-epoch checkpoint. Skipped on the epoch's final step
+            # (the epoch-end save is imminent and strictly richer).
+            if resil is not None and step_idx + 1 < steps_per_epoch:
+                resil.after_step(state, epoch, step_idx + 1)
     finally:
         # EXACTLY-ONCE stop on every exit path: a short epoch that ends
         # before the window's step budget, or a raising step mid-window

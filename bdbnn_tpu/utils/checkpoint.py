@@ -2,18 +2,31 @@
 + ``train.py:345-366, 431-439``.
 
 Layout mirrors the reference's: ``<log_path>/checkpoint`` written every
-epoch, plus ``<log_path>/model_best`` refreshed whenever validation
-top-1 improves. The payload carries ``{epoch, arch, state, best_acc1}``
-(the optimizer state lives inside ``state``). ``reset_resume`` restores
+epoch (and, with ``--save-every-steps`` / ``--save-every-mins`` or on
+preemption, mid-epoch), plus ``<log_path>/model_best`` refreshed
+whenever validation top-1 improves. The Orbax payload carries
+``{epoch, arch, state, best_acc1}`` (the optimizer state lives inside
+``state``); full resume state — ``step_in_epoch``, global LR step, host
+RNG state, ``best_epoch``, the data-pipeline cursor implied by
+(epoch, step_in_epoch) — rides in a ``resume.json`` sidecar INSIDE the
+checkpoint dir, so old checkpoints (no sidecar) keep loading and the
+Orbax restore template never changes shape. ``reset_resume`` restores
 weights only, restarting the schedule (↔ ``--reset_resume``,
 ``train.py:355-361``).
 
 Crash safety: the previous checkpoint is never deleted before the new
 one is durable. Saves go to ``checkpoint.tmp`` and are committed by
-rename (old → ``checkpoint.old`` → removed only after the new dir is in
-place); :func:`load_checkpoint` falls back to ``checkpoint.old`` if a
-crash left no committed dir. (The reference wrote a fresh file then
-copied, ``utils/utils.py:21-25`` — same property, torch idiom.)
+rename; the displaced checkpoint is KEPT as ``checkpoint.old`` (not
+deleted after commit) so a checkpoint that committed but is later found
+corrupt — partial write on a flaky FS, torn by SIGKILL mid-rename —
+still has a fallback. Each save writes an ``INTEGRITY.json`` digest
+(sha256 over every file's path + bytes) inside the dir before commit;
+:func:`load_checkpoint` verifies it and falls back to
+``checkpoint.old`` on mismatch or an unreadable payload instead of
+crashing mid-restore. Saves retry transient ``OSError`` with bounded
+exponential backoff (:func:`retry_io`) — an NFS blip must not kill an
+hours-long run at its save point. Stale ``*.tmp`` dirs from a crashed
+save are cleaned before the next save.
 
 Sharding: restore returns a state PLACED LIKE THE TEMPLATE — every leaf
 is device_put with the template leaf's sharding (params, batch_stats,
@@ -32,27 +45,75 @@ Multi-host: two paths, selected automatically.
   leaves are written once by the primary), barriers bracket the commit
   rename, and restore reconstructs each leaf with the template's
   sharding via ``construct_restore_args`` without materializing the
-  global array on one host. (Closes the round-3 gap: TP>1 x
-  processes>1 was documented-unsupported; reference save path
-  ``train.py:431-439``.) Requires the checkpoint dir on a filesystem
-  all hosts share, as is standard for pod training.
+  global array on one host. Requires the checkpoint dir on a filesystem
+  all hosts share, as is standard for pod training. (The collective
+  save itself is not retried — replaying a barrier-synchronized op
+  after a partial failure is not safe; only the process-0 local commit
+  retries.)
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import shutil
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
 CKPT_NAME = "checkpoint"
 BEST_NAME = "model_best"
+INTEGRITY_NAME = "INTEGRITY.json"
+RESUME_NAME = "resume.json"
+
+# commit-path filesystem ops, indirected so the crash-phase tests can
+# inject a failure between any two of them without touching the ops
+# Orbax performs internally
+_rename = os.rename
+_rmtree = shutil.rmtree
+
+# retry_io defaults: 4 attempts, 0.05s doubling to a 1s cap — a few
+# seconds of patience for an NFS blip, without stalling a preemption
+# grace period
+RETRY_ATTEMPTS = 4
+RETRY_BASE_DELAY_S = 0.05
+RETRY_MAX_DELAY_S = 1.0
 
 
 def _checkpointer() -> ocp.PyTreeCheckpointer:
     return ocp.PyTreeCheckpointer()
+
+
+def retry_io(
+    fn: Callable[[], Any],
+    *,
+    attempts: int = RETRY_ATTEMPTS,
+    base_delay: float = RETRY_BASE_DELAY_S,
+    max_delay: float = RETRY_MAX_DELAY_S,
+    retry_on=(OSError,),
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run ``fn`` with bounded exponential backoff on transient errors.
+
+    Checkpoint saves hit shared filesystems; a transient ``OSError``
+    (stale NFS handle, brief quota/latency spike) must not abort an
+    hours-long run at exactly its durability point. Non-matching
+    exceptions propagate immediately; the last attempt's error
+    propagates unchanged.
+    """
+    last = None
+    for attempt in range(max(attempts, 1)):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            last = e
+            if attempt + 1 >= max(attempts, 1):
+                raise
+            sleep(min(base_delay * (2.0 ** attempt), max_delay))
+    raise last  # unreachable; keeps type-checkers honest
 
 
 def state_is_distributed(state) -> bool:
@@ -75,17 +136,118 @@ def _barrier(name: str) -> None:
         multihost_utils.sync_global_devices(name)
 
 
+# ---------------------------------------------------------------------------
+# Integrity digest
+# ---------------------------------------------------------------------------
+
+
+def dir_digest(path: str) -> Dict[str, Any]:
+    """sha256 over every file under ``path`` (relative path + bytes),
+    excluding the digest file itself. Deterministic walk order, so the
+    digest is stable across hosts/filesystems.
+
+    Cost: one sequential read of the checkpoint (at save, inside the
+    tmp dir before commit; at restore, before Orbax reads it again).
+    Acceptable at mid-epoch-save cadences, which are minutes apart at
+    pod scale; if it ever shows up in a profile, the escape hatch is a
+    manifest-only digest (path + size) with sampled content hashing."""
+    h = hashlib.sha256()
+    files = 0
+    total = 0
+    for root, _dirs, names in sorted(os.walk(path)):
+        for name in sorted(names):
+            if root == path and name == INTEGRITY_NAME:
+                continue
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, path)
+            h.update(rel.encode())
+            h.update(b"\0")
+            with open(fp, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+                    total += len(chunk)
+            h.update(b"\0")
+            files += 1
+    return {
+        "algo": "sha256",
+        "digest": h.hexdigest(),
+        "files": files,
+        "bytes": total,
+    }
+
+
+def write_integrity(ckpt_dir: str) -> Dict[str, Any]:
+    """Digest ``ckpt_dir`` and write ``INTEGRITY.json`` inside it
+    (atomically — a torn digest must read as missing, not as garbage)."""
+    dig = dir_digest(ckpt_dir)
+    path = os.path.join(ckpt_dir, INTEGRITY_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dig, f)
+    os.replace(tmp, path)
+    return dig
+
+
+def verify_integrity(ckpt_dir: str) -> str:
+    """``"ok"`` | ``"missing"`` (pre-digest checkpoint — trusted for
+    backward compat) | ``"mismatch"`` (corrupt/truncated — do not
+    restore from this dir)."""
+    path = os.path.join(ckpt_dir, INTEGRITY_NAME)
+    if not os.path.exists(path):
+        return "missing"
+    try:
+        with open(path) as f:
+            want = json.load(f)
+        got = dir_digest(ckpt_dir)
+    except (OSError, ValueError):
+        return "mismatch"
+    if got["digest"] != want.get("digest"):
+        return "mismatch"
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# Commit protocol
+# ---------------------------------------------------------------------------
+
+
 def _commit(tmp: str, target: str) -> None:
-    """Atomically swap ``tmp`` into ``target``, keeping the previous
-    checkpoint as ``<target>.old`` until the swap lands."""
+    """Swap ``tmp`` into ``target``, keeping the displaced checkpoint
+    as ``<target>.old``.
+
+    Ordered so that a crash between ANY two filesystem operations
+    leaves at least one complete checkpoint on disk
+    (tests/test_checkpoint.py simulates a crash at every phase):
+
+    1. ``rmtree(old)`` — only reached when a committed ``target``
+       exists, so deleting the stale ``old`` is safe;
+    2. ``rename(target, old)`` — the previous checkpoint survives as
+       ``old``; a crash here leaves ``old`` + ``tmp``;
+    3. ``rename(tmp, target)`` — commit.
+
+    The previous version deleted ``old`` unconditionally first (a crash
+    after an earlier crash could strand ONLY ``tmp`` on disk, which
+    ``load_checkpoint`` never reads) and rmtree'd ``old`` again after
+    commit — but ``old`` is exactly the fallback ``load_checkpoint``
+    needs when a *committed* checkpoint turns out corrupt, so it is now
+    retained until the next save displaces it.
+    """
     old = target + ".old"
-    if os.path.exists(old):
-        shutil.rmtree(old)
     if os.path.exists(target):
-        os.rename(target, old)
-    os.rename(tmp, target)
-    if os.path.exists(old):
-        shutil.rmtree(old)
+        if os.path.exists(old):
+            _rmtree(old)
+        _rename(target, old)
+    _rename(tmp, target)
+
+
+def _clean_stale_tmp(save_path: str) -> None:
+    """Remove ``*.tmp`` dirs a crashed save left behind — Orbax refuses
+    to save into an existing directory, so a stale ``checkpoint.tmp``
+    would make every subsequent save fail."""
+    for name in (CKPT_NAME, BEST_NAME):
+        stale = os.path.join(save_path, name + ".tmp")
+        if os.path.exists(stale):
+            _rmtree(stale)
 
 
 def save_checkpoint(
@@ -97,8 +259,17 @@ def save_checkpoint(
     best_acc1: float,
     is_best: bool,
     distributed: Optional[bool] = None,
-) -> None:
-    """Write ``checkpoint`` (and copy to ``model_best`` when best).
+    step_in_epoch: int = 0,
+    resume_state: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write ``checkpoint`` (and copy to ``model_best`` when best);
+    returns the committed checkpoint path.
+
+    ``step_in_epoch`` > 0 marks a MID-EPOCH save: the payload records
+    the *current* epoch (not ``epoch + 1``) so resume re-enters it, and
+    ``resume.json`` carries the step cursor. ``resume_state`` (extra
+    host-side state: RNG, best_epoch, schedule scalars) is merged into
+    the sidecar.
 
     ``distributed`` (auto-detected from the state by default) selects
     the collective all-process path; see the module docstring. In that
@@ -108,41 +279,72 @@ def save_checkpoint(
         distributed = state_is_distributed(state)
     if not distributed:
         if jax.process_index() != 0:
-            return
+            return os.path.join(save_path, CKPT_NAME)
         payload_state = jax.device_get(state)
     else:
         # sharded leaves go to Orbax as live jax.Arrays — each process
         # writes only the shards it owns
         payload_state = state
+    # epoch-end saves keep the historical "next epoch to run" encoding;
+    # mid-epoch saves record the epoch being re-entered
+    payload_epoch = epoch + 1 if step_in_epoch == 0 else epoch
     payload = {
-        "epoch": epoch + 1,
+        "epoch": payload_epoch,
         "arch": arch,
         "best_acc1": float(best_acc1),
         "state": payload_state,
+    }
+    sidecar = {
+        "epoch": payload_epoch,
+        "step_in_epoch": int(step_in_epoch),
+        "best_acc1": float(best_acc1),
+        "saved_unix": round(time.time(), 3),
+        **(resume_state or {}),
     }
     target = os.path.join(save_path, CKPT_NAME)
     tmp = target + ".tmp"
     if jax.process_index() == 0:
         os.makedirs(save_path, exist_ok=True)
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
+        _clean_stale_tmp(save_path)
+
+    def _finalize_tmp():
+        # sidecar + digest land INSIDE tmp before commit, so the digest
+        # covers them and the commit renames everything atomically
+        spath = os.path.join(tmp, RESUME_NAME)
+        with open(spath, "w") as f:
+            json.dump(sidecar, f)
+        write_integrity(tmp)
+
     if distributed:
         _barrier("ckpt-pre-save")
         _checkpointer().save(tmp, payload)
         _barrier("ckpt-post-save")
+        if jax.process_index() == 0:
+            retry_io(_finalize_tmp)
     else:
-        _checkpointer().save(tmp, payload)
+        def _attempt():
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            _checkpointer().save(tmp, payload)
+            _finalize_tmp()
+
+        retry_io(_attempt, retry_on=(OSError,))
     if jax.process_index() == 0:
-        _commit(tmp, target)
+        retry_io(lambda: _commit(tmp, target))
         if is_best:
             best = os.path.join(save_path, BEST_NAME)
             btmp = best + ".tmp"
-            if os.path.exists(btmp):
-                shutil.rmtree(btmp)
-            shutil.copytree(target, btmp)
-            _commit(btmp, best)
+
+            def _best_attempt():
+                if os.path.exists(btmp):
+                    shutil.rmtree(btmp)
+                shutil.copytree(target, btmp)
+                _commit(btmp, best)
+
+            retry_io(_best_attempt)
     if distributed:
         _barrier("ckpt-post-commit")
+    return target
 
 
 def load_variables(path: str) -> Dict[str, Any]:
@@ -159,7 +361,7 @@ def load_variables(path: str) -> Dict[str, Any]:
     best = os.path.join(path, BEST_NAME)
     if os.path.isdir(best):
         path = best
-    payload = _checkpointer().restore(_resolve_ckpt_dir(path))
+    payload = _checkpointer().restore(_candidate_dirs(path)[0])
     state = payload.get("state", payload) if isinstance(payload, dict) else payload
     if not isinstance(state, dict) or "params" not in state:
         raise ValueError(
@@ -171,17 +373,36 @@ def load_variables(path: str) -> Dict[str, Any]:
     }
 
 
-def _resolve_ckpt_dir(path: str) -> str:
-    """Accept a run dir or a checkpoint dir; prefer the committed
-    checkpoint, falling back to ``.old`` after a mid-save crash."""
+def _candidate_dirs(path: str) -> List[str]:
+    """Restore candidates in preference order: the committed checkpoint
+    first, then ``.old`` (survivor of a mid-commit crash, or the
+    fallback for a committed-but-corrupt dir)."""
+    cands: List[str] = []
     if os.path.isdir(path):
-        for name in (CKPT_NAME, CKPT_NAME + ".old"):
-            cand = os.path.join(path, name)
-            if os.path.isdir(cand):
-                return cand
-    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
-        return path + ".old"
-    return path
+        primary = os.path.join(path, CKPT_NAME)
+        if os.path.isdir(primary) or os.path.isdir(primary + ".old"):
+            # a run dir holding checkpoint/ (and maybe checkpoint.old/)
+            for cand in (primary, primary + ".old"):
+                if os.path.isdir(cand):
+                    cands.append(cand)
+            return cands
+        cands.append(path)  # an explicit checkpoint dir
+    if os.path.isdir(path + ".old"):
+        cands.append(path + ".old")
+    return cands or [path]
+
+
+def read_resume_state(ckpt_dir: str) -> Dict[str, Any]:
+    """The ``resume.json`` sidecar of a checkpoint dir ({} when absent
+    — pre-resilience checkpoints)."""
+    path = os.path.join(ckpt_dir, RESUME_NAME)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
 
 
 def load_checkpoint(
@@ -193,11 +414,22 @@ def load_checkpoint(
 ) -> Dict[str, Any]:
     """Restore a checkpoint against a (possibly mesh-sharded) template.
 
-    Returns ``{epoch, arch, best_acc1, state}`` with every state leaf
-    placed per the template leaf's sharding. With ``reset_resume`` the
-    returned epoch/best are zeroed and only weights (params +
-    batch_stats) are taken from the checkpoint — the optimizer state and
-    schedule restart (↔ ``--reset_resume``).
+    Returns ``{epoch, arch, best_acc1, state}`` plus resume metadata:
+    ``step_in_epoch`` / ``best_epoch`` / ``host_rng`` (from the
+    ``resume.json`` sidecar, defaults when absent), ``source`` (the dir
+    actually restored), ``fallback`` (True when the committed dir was
+    corrupt/unreadable and ``checkpoint.old`` was used instead) and
+    ``integrity`` (the verdict for the restored dir). Every state leaf
+    is placed per the template leaf's sharding. With ``reset_resume``
+    the returned epoch/best/cursor are zeroed and only weights (params
+    + batch_stats) are taken from the checkpoint — the optimizer state
+    and schedule restart (↔ ``--reset_resume``).
+
+    Corruption survival: each candidate dir's ``INTEGRITY.json`` is
+    verified before Orbax touches it; a digest mismatch or an Orbax
+    restore error moves on to the next candidate instead of crashing
+    mid-restore. All candidates failing raises with the per-candidate
+    reasons.
 
     ``distributed`` (auto-detected) restores each leaf directly into the
     template leaf's sharding via Orbax ``construct_restore_args`` — no
@@ -205,26 +437,29 @@ def load_checkpoint(
     every process must make this call."""
     if distributed is None:
         distributed = state_is_distributed(state_template)
-    path = _resolve_ckpt_dir(path)
-    if distributed:
-        template = {
-            "epoch": 0,
-            "arch": "",
-            "best_acc1": 0.0,
-            "state": state_template,
-        }
-        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
-        payload = _checkpointer().restore(
-            path, item=template, restore_args=restore_args
+    candidates = _candidate_dirs(path)
+    failures: List[str] = []
+    payload = None
+    used = None
+    integrity = None
+    for i, cand in enumerate(candidates):
+        integrity = verify_integrity(cand)
+        if integrity == "mismatch":
+            failures.append(f"{cand}: integrity digest mismatch")
+            continue
+        try:
+            payload = _restore_payload(cand, state_template, distributed)
+            used = cand
+            break
+        except Exception as e:  # orbax raises various types on torn dirs
+            failures.append(f"{cand}: {type(e).__name__}: {e}")
+    if payload is None:
+        raise RuntimeError(
+            f"no restorable checkpoint under {path!r}; tried:\n  "
+            + "\n  ".join(failures or ["(no candidate dirs)"])
         )
-    else:
-        template = {
-            "epoch": 0,
-            "arch": "",
-            "best_acc1": 0.0,
-            "state": jax.device_get(state_template),
-        }
-        payload = _checkpointer().restore(path, item=template)
+    fallback = used != candidates[0]
+
     # orbax may restore 'state' as the TrainState node (template-typed)
     # or as a plain dict depending on version — normalize to attributes
     restored_state = payload["state"]
@@ -247,20 +482,51 @@ def load_checkpoint(
         params=_placed(_field("params"), state_template.params),
         batch_stats=_placed(_field("batch_stats"), state_template.batch_stats),
     )
+    meta = {"source": used, "fallback": fallback, "integrity": integrity}
     if reset_resume:
         return {
             "epoch": 0,
             "arch": payload["arch"],
             "best_acc1": 0.0,
             "state": state,
+            "step_in_epoch": 0,
+            "best_epoch": -1,
+            "host_rng": None,
+            **meta,
         }
     state = state.replace(
         step=_placed(_field("step"), state_template.step),
         opt_state=_placed(_field("opt_state"), state_template.opt_state),
     )
+    sidecar = read_resume_state(used)
     return {
         "epoch": int(payload["epoch"]),
         "arch": payload["arch"],
         "best_acc1": float(payload["best_acc1"]),
         "state": state,
+        "step_in_epoch": int(sidecar.get("step_in_epoch", 0)),
+        "best_epoch": int(sidecar.get("best_epoch", -1)),
+        "host_rng": sidecar.get("host_rng"),
+        **meta,
     }
+
+
+def _restore_payload(ckpt_dir: str, state_template, distributed: bool):
+    if distributed:
+        template = {
+            "epoch": 0,
+            "arch": "",
+            "best_acc1": 0.0,
+            "state": state_template,
+        }
+        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        return _checkpointer().restore(
+            ckpt_dir, item=template, restore_args=restore_args
+        )
+    template = {
+        "epoch": 0,
+        "arch": "",
+        "best_acc1": 0.0,
+        "state": jax.device_get(state_template),
+    }
+    return _checkpointer().restore(ckpt_dir, item=template)

@@ -370,3 +370,168 @@ class TestCifarPickleBranch:
                                     for v in (10, 20, 30)], axis=-1)
         )
         assert ds.labels[0] == 4
+
+
+class TestResumableIterators:
+    """Mid-epoch resume cursors (preemption tolerance): ``epoch(e,
+    start_step=k)`` must yield EXACTLY the batches an uninterrupted
+    ``epoch(e)`` yields from batch k on — including augmentation draws,
+    which are derived per batch/sample, never from a sequential stream
+    a skip would desynchronize."""
+
+    def test_pipeline_tail_is_bitwise_identical(self):
+        ds = synthetic_dataset(96, 8, 4, seed=1)
+        pipe = Pipeline(ds, 16, train=True, seed=5, prefetch=0)
+        full = list(pipe.epoch(2))
+        assert len(full) == 6
+        for k in (1, 3, 5):
+            tail = list(pipe.epoch(2, start_step=k))
+            assert len(tail) == len(full) - k
+            for (xf, yf), (xt, yt) in zip(full[k:], tail):
+                np.testing.assert_array_equal(xf, xt)
+                np.testing.assert_array_equal(yf, yt)
+
+    def test_pipeline_tail_identical_with_prefetch_thread(self):
+        ds = synthetic_dataset(64, 8, 4, seed=1)
+        full = list(Pipeline(ds, 16, train=True, seed=5, prefetch=0).epoch(0))
+        tail = list(
+            Pipeline(ds, 16, train=True, seed=5, prefetch=3).epoch(
+                0, start_step=2
+            )
+        )
+        for (xf, yf), (xt, yt) in zip(full[2:], tail):
+            np.testing.assert_array_equal(xf, xt)
+            np.testing.assert_array_equal(yf, yt)
+
+    def test_imagefolder_tail_is_bitwise_identical(self, jpeg_folder):
+        from bdbnn_tpu.data import ImageFolderPipeline
+
+        pipe = ImageFolderPipeline(
+            jpeg_folder, 8, train=True, image_size=32, seed=3,
+            num_threads=2,
+        )
+        full = list(pipe.epoch(1))
+        tail = list(pipe.epoch(1, start_step=1))
+        assert len(tail) == len(full) - 1
+        for (xf, yf), (xt, yt) in zip(full[1:], tail):
+            np.testing.assert_array_equal(xf, xt)
+            np.testing.assert_array_equal(yf, yt)
+
+    def test_mp_imagefolder_tail_is_bitwise_identical(self, jpeg_folder):
+        from bdbnn_tpu.data import MPImageFolderPipeline
+
+        pipe = MPImageFolderPipeline(
+            jpeg_folder, 8, train=True, image_size=32, seed=3,
+            num_workers=2,
+        )
+        try:
+            full = list(pipe.epoch(0))
+            tail = list(pipe.epoch(0, start_step=2))
+        finally:
+            pipe.close()
+        for (xf, yf), (xt, yt) in zip(full[2:], tail):
+            np.testing.assert_array_equal(xf, xt)
+            np.testing.assert_array_equal(yf, yt)
+
+
+class TestGracefulDataDegradation:
+    """One corrupt image must cost one substituted sample + one
+    recorded ``data_error`` — not the run (ImageFolderPipeline._load_one
+    retry -> deterministic-neighbor substitute)."""
+
+    @pytest.fixture
+    def folder_with_corruption(self, tmp_path):
+        from PIL import Image
+
+        from bdbnn_tpu.data import ImageFolder
+
+        rng = np.random.default_rng(0)
+        d = tmp_path / "train" / "a"
+        d.mkdir(parents=True)
+        for i in range(8):
+            arr = rng.integers(0, 255, size=(48, 48, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i:03d}.jpg")
+        # truncate one file mid-stream (the classic bitrot/partial-copy
+        # failure PIL raises OSError on)
+        victim = d / "003.jpg"
+        data = victim.read_bytes()
+        victim.write_bytes(data[: len(data) // 2])
+        return ImageFolder(str(tmp_path / "train"))
+
+    def test_corrupt_sample_is_substituted_and_reported(
+        self, folder_with_corruption
+    ):
+        from bdbnn_tpu.data import ImageFolderPipeline
+
+        pipe = ImageFolderPipeline(
+            folder_with_corruption, 4, train=False, image_size=32,
+            num_threads=2,
+        )
+        seen = []
+        pipe.on_data_error = seen.append
+        batches = list(pipe.epoch(0))
+        # the epoch completes at full size despite the corrupt file
+        assert sum(len(y) for _, y in batches) == 8
+        assert len(seen) == 1
+        err = seen[0]
+        assert err["index"] == 3
+        assert err["substitute"] == 4  # deterministic neighbor
+        assert err["path"].endswith("003.jpg")
+        assert "Error" in err["error"] or "error" in err["error"].lower()
+
+    def test_mp_pipeline_substitutes_and_reports(
+        self, folder_with_corruption
+    ):
+        """The pod-grade multiprocess backend keeps the same contract:
+        the substitution happens in the worker process and the error
+        travels back over the result pipe to on_data_error."""
+        from bdbnn_tpu.data import MPImageFolderPipeline
+
+        pipe = MPImageFolderPipeline(
+            folder_with_corruption, 4, train=False, image_size=32,
+            num_workers=2,
+        )
+        seen = []
+        pipe.on_data_error = seen.append
+        try:
+            batches = list(pipe.epoch(0))
+        finally:
+            pipe.close()
+        assert sum(len(y) for _, y in batches) == 8
+        assert len(seen) == 1
+        assert seen[0]["index"] == 3 and seen[0]["substitute"] == 4
+        assert seen[0]["path"].endswith("003.jpg")
+
+    def test_corrupt_sample_substitution_is_deterministic(
+        self, folder_with_corruption
+    ):
+        from bdbnn_tpu.data import ImageFolderPipeline
+
+        pipe = ImageFolderPipeline(
+            folder_with_corruption, 4, train=True, image_size=32,
+            num_threads=2,
+        )
+        a = list(pipe.epoch(0))
+        b = list(pipe.epoch(0))
+        for (xa, ya), (xb, yb) in zip(a, b):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+
+    def test_all_corrupt_raises(self, tmp_path):
+        from PIL import Image
+
+        from bdbnn_tpu.data import ImageFolder, ImageFolderPipeline
+
+        d = tmp_path / "train" / "a"
+        d.mkdir(parents=True)
+        arr = np.zeros((32, 32, 3), np.uint8)
+        for i in range(2):
+            Image.fromarray(arr).save(d / f"{i}.jpg")
+        for p in d.iterdir():
+            p.write_bytes(b"not an image at all")
+        pipe = ImageFolderPipeline(
+            ImageFolder(str(tmp_path / "train")), 2, train=False,
+            image_size=32, num_threads=1,
+        )
+        with pytest.raises(Exception):
+            list(pipe.epoch(0))

@@ -80,9 +80,11 @@ class TestEmitCallSites:
             + "\n".join(unregistered)
         )
         # the scan actually saw the package's core kinds (guards
-        # against the AST walk silently matching nothing)
+        # against the AST walk silently matching nothing) — including
+        # the four resilience kinds, which must keep real call sites
         assert {"run_start", "compile", "train_interval", "eval",
-                "memory", "profile", "run_end"} <= found
+                "memory", "profile", "run_end",
+                "checkpoint", "restore", "preempt", "data_error"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync."""

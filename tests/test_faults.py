@@ -1,0 +1,358 @@
+"""Fault-injection harness: kill training at arbitrary points, resume,
+and prove the result matches an uninterrupted run.
+
+The preemption claim this repo makes (docs/design.md §7) is *exact
+resume*: a run killed at ANY step and restarted from its last
+checkpoint reaches the same final state as if it had never been killed
+— including the schedule state BNN dynamics are sensitive to (EDE
+(t, k), the step-indexed LR position, the kurtosis epoch gate: a
+resume that fast-forwards those wrong corrupts the bimodal-distribution
+training the paper depends on, and sign-flip sensitivity turns small
+drift into large flip-rate artifacts).
+
+Three tiers:
+
+- **SIGTERM (graceful preemption)** — delivered to an in-process
+  ``cli.main`` run mid-epoch; asserts the preemption protocol: flag
+  checked at a step boundary, mid-epoch checkpoint committed,
+  ``preempt`` + ``checkpoint`` events, exit code 75 (EX_TEMPFAIL),
+  then resume → final state matches the uninterrupted baseline.
+- **SIGKILL (hard kill, subprocess)** — no cleanup possible, so
+  survival rests entirely on the durable-commit protocol: the victim
+  subprocess is SIGKILLed right after its first mid-epoch interval
+  checkpoint commits; resume matches the baseline and the resume
+  point's schedule state is BITWISE-identical to what the victim
+  recorded at save time.
+- **randomized kill matrix** (``slow``) — SIGKILL at random offsets.
+
+Cost control (tier-1 budget): everything runs the 2-stage width-8
+``resnet8_tiny`` on 4-step synthetic epochs; the baseline fit is a
+module fixture shared by every comparison; only the SIGKILL victim is
+a real subprocess (SIGTERM is exercised in-process, which covers the
+identical handler/save/raise path without a second interpreter+compile
+bill).
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from bdbnn_tpu.configs.config import RunConfig
+from bdbnn_tpu.train.loop import fit
+from bdbnn_tpu.train.resilience import PREEMPT_EXIT_CODE
+from bdbnn_tpu.utils.checkpoint import CKPT_NAME, load_variables
+
+EPOCHS = 2
+STEPS_PER_EPOCH = 4  # 128 synthetic examples / batch 32
+
+BASE = dict(
+    dataset="cifar10",
+    synthetic=True,
+    synthetic_train_size=128,
+    synthetic_val_size=64,
+    arch="resnet8_tiny",
+    epochs=EPOCHS,
+    batch_size=32,
+    lr=0.05,
+    print_freq=1,
+    seed=0,
+    workers=2,
+    # nontrivial schedule state at the resume point: EDE anneal on, and
+    # the kurtosis gate flips open at epoch 1 — exactly the scalars a
+    # wrong fast-forward would corrupt
+    ede=True,
+    kurtepoch=1,
+    save_every_steps=2,
+)
+
+
+def _cfg(log_path, **kw):
+    return RunConfig(**{**BASE, "log_path": str(log_path), **kw})
+
+
+def _cli_args(log_path):
+    """The CLI surface of ``BASE`` (subprocess + in-process main)."""
+    return [
+        "--synthetic",
+        "--synthetic-train-size", "128",
+        "--synthetic-val-size", "64",
+        "-a", "resnet8_tiny",
+        "--epochs", str(EPOCHS),
+        "-b", "32",
+        "-lr", "0.05",
+        "-p", "1",
+        "--seed", "0",
+        "-j", "2",
+        "--ede",
+        "--kurtepoch", "1",
+        "--save-every-steps", "2",
+        "--log_path", str(log_path),
+    ]
+
+
+def _run_dir(root):
+    hits = glob.glob(os.path.join(str(root), "**", "events.jsonl"),
+                     recursive=True)
+    assert hits, f"no events.jsonl under {root}"
+    return os.path.dirname(sorted(hits)[-1])
+
+
+def _events(run_dir, kind=None):
+    out = []
+    path = os.path.join(run_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a killed writer
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def _wait_for_event(root, predicate, timeout=120.0, poll=0.05):
+    """Poll the newest run dir under ``root`` until an event matches."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        hits = glob.glob(os.path.join(str(root), "**", "events.jsonl"),
+                         recursive=True)
+        if hits:
+            run_dir = os.path.dirname(sorted(hits)[-1])
+            for e in _events(run_dir):
+                if predicate(e):
+                    return run_dir, e
+        time.sleep(poll)
+    return None, None
+
+
+def _final_params(run_dir):
+    """Params of the run's FINAL committed checkpoint (not model_best —
+    the equality claim is about where training ended up)."""
+    return load_variables(os.path.join(run_dir, CKPT_NAME))
+
+
+def _assert_params_equal(a, b):
+    la = jax.tree_util.tree_leaves(a["params"])
+    lb = jax.tree_util.tree_leaves(b["params"])
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=0, atol=1e-6
+        )
+
+
+SCHED_KEYS = ("epoch", "step_in_epoch", "lr_step", "ede_t", "ede_k",
+              "kurt_gate")
+
+
+def _assert_schedule_bitwise(saved_ckpt_event, restore_event):
+    """The resumed run must re-enter with EXACTLY the schedule state the
+    interrupted run froze — bitwise, no tolerance: these scalars are
+    pure functions of (epoch, step) and any drift is a resume bug."""
+    for key in SCHED_KEYS:
+        assert restore_event[key] == saved_ckpt_event[key], (
+            key, saved_ckpt_event, restore_event,
+        )
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """ONE uninterrupted run; every kill/resume result compares to it."""
+    root = tmp_path_factory.mktemp("baseline")
+    res = fit(_cfg(root))
+    run_dir = _run_dir(root)
+    return {
+        "res": res,
+        "run_dir": run_dir,
+        "params": _final_params(run_dir),
+    }
+
+
+class TestSigtermPreemption:
+    """Graceful preemption through the real CLI entry point."""
+
+    @pytest.fixture(scope="class")
+    def preempted(self, tmp_path_factory):
+        from bdbnn_tpu.cli import main
+
+        root = tmp_path_factory.mktemp("sigterm")
+
+        def _assassin():
+            # SIGTERM once training is demonstrably mid-epoch (a step
+            # beyond the first has completed and a checkpoint exists to
+            # resume from if the flag lands before the next save)
+            _wait_for_event(
+                root,
+                lambda e: e.get("kind") == "train_interval"
+                and e.get("step", 0) >= 1,
+            )
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        t = threading.Thread(target=_assassin, daemon=True)
+        t.start()
+        rc = main(_cli_args(root))
+        t.join(timeout=5)
+        return {"rc": rc, "run_dir": _run_dir(root)}
+
+    def test_exit_code_is_preempt(self, preempted):
+        assert preempted["rc"] == PREEMPT_EXIT_CODE == 75
+
+    def test_preempt_protocol_events(self, preempted):
+        run_dir = preempted["run_dir"]
+        preempts = _events(run_dir, "preempt")
+        assert len(preempts) == 1
+        p = preempts[0]
+        assert p["signum"] == signal.SIGTERM
+        ckpts = _events(run_dir, "checkpoint")
+        assert ckpts, "no checkpoint events from the preempted run"
+        last = ckpts[-1]
+        # the final checkpoint is the preemption save (or, if the flag
+        # landed at an epoch boundary, the epoch-end save) and its
+        # cursor matches the preempt event's
+        assert last["epoch"] == p["epoch"]
+        assert last["step_in_epoch"] == p["step_in_epoch"]
+        assert any(c["reason"] == "preempt" for c in ckpts) or (
+            p["step_in_epoch"] == 0
+        )
+        # run_end never fired — the run was cut short
+        assert not _events(run_dir, "run_end")
+
+    def test_resume_matches_uninterrupted(
+        self, preempted, baseline, tmp_path
+    ):
+        victim_dir = preempted["run_dir"]
+        res = fit(_cfg(tmp_path / "resumed", resume=victim_dir))
+        run_dir = _run_dir(tmp_path / "resumed")
+
+        restore = _events(run_dir, "restore")[0]
+        saved = _events(victim_dir, "checkpoint")[-1]
+        _assert_schedule_bitwise(saved, restore)
+        assert restore["integrity"] == "ok"
+        assert restore["fallback"] is False
+
+        assert res["best_acc1"] == pytest.approx(
+            baseline["res"]["best_acc1"], abs=1e-3
+        )
+        _assert_params_equal(_final_params(run_dir), baseline["params"])
+
+        # restart lineage recorded for the summarize/watch surfaces
+        with open(os.path.join(run_dir, "manifest.json")) as f:
+            man = json.load(f)
+        assert man["resumed_from"] == os.path.abspath(victim_dir)
+        assert man["restart_lineage"] == [os.path.abspath(victim_dir)]
+
+
+class TestSigkillResume:
+    """Hard kill: no handler, no cleanup — only the committed mid-epoch
+    checkpoint survives. The acceptance-criteria test."""
+
+    @pytest.fixture(scope="class")
+    def killed(self, tmp_path_factory):
+        root = tmp_path_factory.mktemp("sigkill")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "bdbnn_tpu.cli", *_cli_args(root)],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            # first mid-epoch interval checkpoint committed -> SIGKILL.
+            # ~6 steps + eval remain (seconds), so the kill always lands
+            # before the run can finish.
+            run_dir, _ = _wait_for_event(
+                root,
+                lambda e: e.get("kind") == "checkpoint"
+                and e.get("step_in_epoch", 0) > 0,
+                timeout=300.0,
+            )
+            assert run_dir is not None, "victim never checkpointed"
+            proc.kill()
+            rc = proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=60)
+        assert rc == -signal.SIGKILL
+        return {"run_dir": run_dir}
+
+    def test_resume_matches_uninterrupted(self, killed, baseline, tmp_path):
+        victim_dir = killed["run_dir"]
+        saved = _events(victim_dir, "checkpoint")[-1]
+        assert saved["step_in_epoch"] > 0  # genuinely mid-epoch
+        assert not _events(victim_dir, "run_end")
+
+        res = fit(_cfg(tmp_path / "resumed", resume=victim_dir))
+        run_dir = _run_dir(tmp_path / "resumed")
+
+        restore = _events(run_dir, "restore")[0]
+        _assert_schedule_bitwise(saved, restore)
+        assert restore["integrity"] == "ok"
+
+        assert res["best_acc1"] == pytest.approx(
+            baseline["res"]["best_acc1"], abs=1e-3
+        )
+        _assert_params_equal(_final_params(run_dir), baseline["params"])
+
+
+@pytest.mark.slow
+class TestKillMatrix:
+    """SIGKILL at randomized offsets — the broad sweep of the same
+    invariant. Excluded from tier-1 (`-m 'not slow'`); run explicitly
+    when touching the checkpoint/resume machinery."""
+
+    @pytest.mark.parametrize("trial", range(3))
+    def test_random_offset_kill_then_resume(
+        self, trial, baseline, tmp_path
+    ):
+        rng = np.random.default_rng(trial)
+        root = tmp_path / "victim"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "bdbnn_tpu.cli", *_cli_args(root)],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            run_dir, _ = _wait_for_event(
+                root,
+                lambda e: e.get("kind") == "train_interval",
+                timeout=300.0,
+            )
+            assert run_dir is not None
+            time.sleep(float(rng.uniform(0.0, 2.0)))
+            proc.kill()
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=60)
+        if not os.path.isdir(os.path.join(run_dir, CKPT_NAME)) and not (
+            os.path.isdir(os.path.join(run_dir, CKPT_NAME + ".old"))
+        ):
+            pytest.skip("killed before any checkpoint committed")
+        res = fit(_cfg(tmp_path / "resumed", resume=run_dir))
+        assert res["best_acc1"] == pytest.approx(
+            baseline["res"]["best_acc1"], abs=1e-3
+        )
+        _assert_params_equal(
+            _final_params(_run_dir(tmp_path / "resumed")),
+            baseline["params"],
+        )
