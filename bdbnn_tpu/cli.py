@@ -170,6 +170,43 @@ def build_parser() -> argparse.ArgumentParser:
         "train loss: fail fast (default), warn + record the event, or "
         "skip detection",
     )
+    # online health monitor (obs/health.py)
+    p.add_argument(
+        "--no-health", dest="health", action="store_false",
+        help="disable the online training-health monitor (flip "
+        "collapse/explosion, kurtosis divergence, loss spike/plateau, "
+        "throughput regression, HBM creep detectors over signals "
+        "already collected at each metric drain)",
+    )
+    p.add_argument(
+        "--no-health-forensics", dest="health_forensics",
+        action="store_false",
+        help="alerts still emit `alert` events but no longer snapshot "
+        "a forensics checkpoint or open a trace capture window",
+    )
+    p.add_argument(
+        "--health-forensics-steps", type=int, default=4,
+        help="trace-window length (steps) captured after an alert "
+        "(default 4)",
+    )
+    p.add_argument(
+        "--health-max-forensics", type=int, default=2,
+        help="max auto-forensics captures per run (default 2; 0 "
+        "disables forensics without disabling alerts)",
+    )
+    p.add_argument(
+        "--health-threshold", action="append", default=[],
+        metavar="NAME=VALUE", dest="health_thresholds",
+        help="override a detector threshold (repeatable), e.g. "
+        "--health-threshold loss_spike_factor=5; names are the "
+        "obs.health.HealthConfig fields",
+    )
+    p.add_argument(
+        "--events-max-mb", type=float, default=256.0,
+        help="rotate events.jsonl to events.<N>.jsonl past this size "
+        "in MiB (default 256; 0 = unbounded) — readers see one "
+        "continuous timeline either way",
+    )
     # legacy GPU/NCCL flags: accepted, ignored
     for flag, kw in [
         ("--world-size", dict(type=int, default=1)),
@@ -259,13 +296,21 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         profile_at=tuple(args.profile_at),
         probe_binarization=args.probe_binarization,
         nonfinite_policy=args.nonfinite_policy,
+        health=args.health,
+        health_forensics=args.health_forensics,
+        health_forensics_steps=args.health_forensics_steps,
+        health_max_forensics=args.health_max_forensics,
+        health_thresholds=tuple(args.health_thresholds),
+        events_max_mb=args.events_max_mb,
     )
 
 
 def summarize_main(argv) -> int:
-    """``python -m bdbnn_tpu.cli summarize RUN_DIR [--json]`` — post-hoc
-    report over a run directory's manifest + scalars + events. Reads
-    files only; never initializes a JAX backend."""
+    """``python -m bdbnn_tpu.cli summarize RUN_DIR [--json] [--strict]``
+    — post-hoc report over a run directory's manifest + scalars +
+    events. Reads files only; never initializes a JAX backend.
+    ``--strict`` exits nonzero when any run-ending (critical) health
+    alert fired, so tier-1/CI can gate on run health."""
     import json
 
     ap = argparse.ArgumentParser(
@@ -278,13 +323,101 @@ def summarize_main(argv) -> int:
         "--json", action="store_true",
         help="emit the machine-readable summary instead of the report",
     )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="exit nonzero (3) when any run-ending (critical) health "
+        "alert fired, listing them on stderr — the CI run-health gate",
+    )
     args = ap.parse_args(argv)
 
     from bdbnn_tpu.obs.summarize import summarize_run
 
     report, summary = summarize_run(args.run_dir)
     print(json.dumps(summary, indent=2) if args.json else report)
+    if args.strict:
+        critical = (summary.get("health") or {}).get("critical") or []
+        if critical:
+            print(
+                f"[summarize --strict] {len(critical)} run-ending "
+                "alert(s):",
+                file=sys.stderr,
+            )
+            for a in critical:
+                print(
+                    f"  {a.get('detector')} at epoch {a.get('epoch')} "
+                    f"step {a.get('step')}: {a.get('message')}",
+                    file=sys.stderr,
+                )
+            return 3
     return 0
+
+
+def compare_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli compare BASELINE CANDIDATE... [--json]``
+    — machine-checkable run-vs-run regression verdict over run dirs
+    and/or BENCH_*/ACCURACY_* artifacts. Exit codes: 0 pass, 3
+    regression beyond tolerance, 2 incomparable (provenance mismatch
+    without ``--allow-mismatch``, or zero shared metrics — a gate must
+    not pass a comparison that compared nothing). Reads files only; no
+    JAX backend."""
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli compare",
+        description="Compare runs against the first (baseline): "
+        "time-to-accuracy, top-1, jit step ms, img/s, MFU, HBM peak, "
+        "alert counts — with configurable regression tolerances, so "
+        "the verdict can serve as a CI/perf gate.",
+    )
+    ap.add_argument(
+        "paths", nargs="+", metavar="RUN",
+        help="baseline first, then candidate run dir(s) or "
+        "BENCH_*/ACCURACY_* artifact JSONs",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable verdict instead of the table",
+    )
+    ap.add_argument(
+        "--tol-acc", type=float, default=0.5, metavar="PP",
+        help="top-1 regression tolerance in percentage points "
+        "(default 0.5)",
+    )
+    ap.add_argument(
+        "--tol-rel", type=float, default=0.10, metavar="FRAC",
+        help="relative tolerance for time/throughput/step-ms/MFU "
+        "metrics (default 0.10)",
+    )
+    ap.add_argument(
+        "--tol-hbm", type=float, default=0.05, metavar="FRAC",
+        help="relative tolerance for HBM peak growth (default 0.05)",
+    )
+    ap.add_argument(
+        "--allow-mismatch", action="store_true",
+        help="compare even when arch/dataset/recipe provenance "
+        "differs (default: refuse, exit 2)",
+    )
+    args = ap.parse_args(argv)
+    if len(args.paths) < 2:
+        ap.error("need a baseline and at least one candidate")
+
+    from bdbnn_tpu.obs.compare import compare_runs, render_comparison
+
+    result = compare_runs(
+        args.paths,
+        tol_acc_pp=args.tol_acc,
+        tol_rel=args.tol_rel,
+        tol_hbm=args.tol_hbm,
+        allow_mismatch=args.allow_mismatch,
+    )
+    print(
+        json.dumps(result, indent=2, sort_keys=True)
+        if args.json
+        else render_comparison(result)
+    )
+    return {"pass": 0, "regression": 3, "incomparable": 2}[
+        result["verdict"]
+    ]
 
 
 def watch_main(argv) -> int:
@@ -320,12 +453,14 @@ def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     # subcommand dispatch ahead of the reference-compatible flag surface
-    # (a dataset dir named "summarize"/"watch" would shadow it — none
-    # does)
+    # (a dataset dir named "summarize"/"watch"/"compare" would shadow
+    # it — none does)
     if argv and argv[0] == "summarize":
         return summarize_main(argv[1:])
     if argv and argv[0] == "watch":
         return watch_main(argv[1:])
+    if argv and argv[0] == "compare":
+        return compare_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
 

@@ -142,6 +142,23 @@ class RunConfig:
     # best-acc tracking), "warn" logs + records the event, "ignore"
     # skips detection entirely (the step doesn't emit the flag)
     nonfinite_policy: str = "raise"
+    # online health monitor (obs/health.py): per-drain detectors over
+    # signals already collected — flip collapse/explosion, kurtosis
+    # divergence, loss spike/plateau, throughput regression, HBM creep.
+    # Alerts are `alert` events; with health_forensics an alert also
+    # snapshots a checkpoint under <run_dir>/forensics/ and opens a
+    # bounded trace window (health_forensics_steps steps), capped at
+    # health_max_forensics per run. health_thresholds carries
+    # "NAME=VALUE" overrides of HealthConfig fields.
+    health: bool = True
+    health_forensics: bool = True
+    health_forensics_steps: int = 4
+    health_max_forensics: int = 2
+    health_thresholds: Tuple[str, ...] = ()
+    # events.jsonl size cap in MiB before rotation to events.<N>.jsonl
+    # (obs/events.py); 0 = unbounded. Keeps multi-day runs from filling
+    # the disk with interval events.
+    events_max_mb: float = 256.0
 
     @property
     def num_classes(self) -> int:
@@ -175,6 +192,20 @@ class RunConfig:
 
             for spec in self.profile_at:
                 parse_profile_at(spec, default_steps=self.profile_steps)
+        if self.health_thresholds:
+            # unknown detector-threshold names fail at config time, not
+            # at the first drain hours into the run
+            from bdbnn_tpu.obs.health import HealthConfig, apply_overrides
+
+            apply_overrides(HealthConfig(), self.health_thresholds)
+        if self.health_forensics_steps < 1:
+            raise ValueError("--health-forensics-steps must be >= 1")
+        if self.health_max_forensics < 0:
+            raise ValueError("--health-max-forensics must be >= 0")
+        if self.events_max_mb < 0:
+            raise ValueError(
+                "--events-max-mb must be >= 0 (0 disables rotation)"
+            )
         if self.save_every_steps < 0 or self.save_every_mins < 0:
             raise ValueError(
                 "--save-every-steps / --save-every-mins must be >= 0 "

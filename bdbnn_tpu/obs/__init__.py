@@ -29,11 +29,18 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
+from bdbnn_tpu.obs.compare import compare_runs, extract_run, render_comparison
 from bdbnn_tpu.obs.events import (
     EVENTS_NAME,
     KNOWN_KINDS,
     EventWriter,
+    load_events,
     read_events,
+)
+from bdbnn_tpu.obs.health import (
+    HealthConfig,
+    HealthMonitor,
+    apply_overrides as apply_health_overrides,
 )
 from bdbnn_tpu.obs.manifest import (
     MANIFEST_NAME,
@@ -69,6 +76,12 @@ class ObsHooks:
     nonfinite_policy: str = "raise"
     # --profile-at capture windows (None = no windows requested)
     tracer: Optional[TraceCapture] = None
+    # online health monitor (obs/health.py; None = --no-health)
+    health: Optional[HealthMonitor] = None
+    # fit()-scoped auto-forensics callback:
+    # forensics(state, epoch, step_cursor, alerts) — snapshots a
+    # checkpoint + schedules a trace window when an alert fires
+    forensics: Optional[Any] = None
 
 
 __all__ = [
@@ -79,20 +92,27 @@ __all__ = [
     "KNOWN_KINDS",
     "MANIFEST_NAME",
     "EventWriter",
+    "HealthConfig",
+    "HealthMonitor",
     "ObsHooks",
     "RunManifest",
     "StepPhaseTimer",
     "TraceCapture",
+    "apply_health_overrides",
     "attribute_trace",
+    "compare_runs",
     "config_hash",
     "emit_memory_event",
+    "extract_run",
     "find_trace_file",
     "hbm_watermark",
     "hlo_breakdown",
     "jit_step_ms",
+    "load_events",
     "parse_profile_at",
     "read_events",
     "read_manifest",
+    "render_comparison",
     "resolve_run_dir",
     "summarize_run",
     "write_manifest",

@@ -1,0 +1,433 @@
+"""Cross-run regression comparison: ``compare`` CLI engine.
+
+The repo accumulates run directories and artifact JSONs
+(``ACCURACY_*.json`` accuracy curves, ``BENCH_*.json`` bench lines)
+that until now were compared by eyeball. This module turns "did this
+change regress the run?" into a machine-checkable verdict:
+
+- :func:`extract_run` normalizes any source — a telemetry run dir
+  (manifest + events + scalars), an ``ACCURACY_*``-shaped artifact, or
+  a ``BENCH_*``-shaped artifact — into one ``{provenance, metrics}``
+  record;
+- :func:`compare_runs` aligns candidates against a baseline on
+  manifest provenance (arch, dataset, recipe fields), then judges each
+  shared metric against a configurable tolerance: time-to-accuracy,
+  best/final top-1, jit step ms, img/s, MFU, HBM peak, wall time, and
+  run-ending alert counts;
+- :func:`render_comparison` renders the human table; the verdict dict
+  itself is strict JSON (``--json``) and deterministic — no clocks, no
+  absolute paths beyond what the caller passed — so it can be diffed,
+  committed, and used as a CI/perf gate (nonzero exit on regression).
+
+Stdlib-only (obs-package rule): comparisons never initialize a JAX
+backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from bdbnn_tpu.obs.events import jsonsafe, read_events
+from bdbnn_tpu.obs.health import RUN_ENDING_SEVERITY
+from bdbnn_tpu.obs.manifest import read_manifest
+from bdbnn_tpu.obs.memory import hbm_watermark
+from bdbnn_tpu.obs.trace import attribute_trace, find_trace_file
+
+# config fields that define "the same experiment": two runs disagreeing
+# on any of these are a recipe change, not a regression — compare
+# refuses (exit 2) unless --allow-mismatch. Unknown (None/absent on
+# either side) never counts as a mismatch: artifacts carry partial
+# provenance.
+RECIPE_FIELDS: Tuple[str, ...] = (
+    "arch", "dataset", "ede", "w_kurtosis", "w_kurtosis_target",
+    "kurtosis_mode", "imagenet_setting_step_2_ts", "react", "twoblock",
+    "dtype", "batch_size", "epochs", "lr", "opt_policy",
+)
+
+# metric -> (direction, tolerance kind). Directions: "higher" is
+# better or "lower" is better. Tolerance kinds: "acc" = absolute
+# percentage points (tol_acc_pp), "rel" = fraction of the baseline
+# (tol_rel), "hbm" = fraction of the baseline (tol_hbm), "count" =
+# any increase is a regression.
+METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
+    ("best_acc1", "higher", "acc"),
+    ("final_acc1", "higher", "acc"),
+    ("time_to_common_acc_s", "lower", "rel"),
+    ("time_to_target_s", "lower", "rel"),
+    ("wall_s", "lower", "rel"),
+    ("img_per_s", "higher", "rel"),
+    ("jit_step_ms", "lower", "rel"),
+    ("mfu", "higher", "rel"),
+    ("hbm_peak_bytes", "lower", "hbm"),
+    ("alerts_critical", "lower", "count"),
+)
+
+
+def _recipe_from_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: cfg.get(k) for k in RECIPE_FIELDS}
+
+
+def _extract_run_dir(path: str) -> Dict[str, Any]:
+    from bdbnn_tpu.obs.summarize import resolve_run_dir
+
+    run_dir = resolve_run_dir(path)
+    manifest = read_manifest(run_dir) or {}
+    events = read_events(run_dir)
+    cfg = manifest.get("config") or {}
+
+    evals = [e for e in events if e.get("kind") == "eval"]
+    intervals = [e for e in events if e.get("kind") == "train_interval"]
+    memory = [e for e in events if e.get("kind") == "memory"]
+    alerts = [e for e in events if e.get("kind") == "alert"]
+    end = next((e for e in events if e.get("kind") == "run_end"), None)
+    t0 = events[0]["t"] if events else None
+
+    best_acc1 = None
+    if end is not None and end.get("best_acc1") is not None:
+        best_acc1 = float(end["best_acc1"])
+    elif evals:
+        best_acc1 = max(float(e.get("acc1") or 0.0) for e in evals)
+    final_acc1 = float(evals[-1]["acc1"]) if evals else None
+
+    # time-to-accuracy curve (elapsed seconds vs run start) kept raw so
+    # compare_runs can evaluate it at whatever level both runs reached
+    acc_curve = [
+        (float(e.get("acc1") or 0.0), round(float(e["t"]) - t0, 1))
+        for e in evals
+        if t0 is not None and e.get("t") is not None
+    ]
+
+    img_rates = [
+        float(e["img_per_s"])
+        for e in intervals[1:]  # skip the compile-tainted first interval
+        if isinstance(e.get("img_per_s"), (int, float))
+    ] or [
+        float(e["img_per_s"])
+        for e in intervals
+        if isinstance(e.get("img_per_s"), (int, float))
+    ]
+    img_per_s = (
+        round(sorted(img_rates)[len(img_rates) // 2], 2)
+        if img_rates else None
+    )
+
+    jit_step_ms = mfu = None
+    profile_evs = [e for e in events if e.get("kind") == "profile"]
+    if profile_evs:
+        pe = profile_evs[-1]
+        trace = None
+        for root in (run_dir, pe.get("trace_dir") or ""):
+            if root and os.path.isdir(root):
+                trace = find_trace_file(root)
+                if trace:
+                    break
+        if trace:
+            from bdbnn_tpu.obs.trace import BF16_PEAK_TFLOPS
+
+            att = attribute_trace(
+                trace,
+                pe.get("steps") or 1,
+                flops_per_step=pe.get("flops_per_step"),
+                peak_tflops=BF16_PEAK_TFLOPS.get(
+                    manifest.get("device_kind", "")
+                ),
+            )
+            jit_step_ms = att.get("step_total_ms")
+            mfu = att.get("mfu")
+
+    wm = hbm_watermark(memory)
+    return {
+        "source": path,
+        "format": "run_dir",
+        "provenance": {
+            "config_hash": manifest.get("config_hash"),
+            "device_kind": manifest.get("device_kind"),
+            "recipe": _recipe_from_config(cfg),
+        },
+        "metrics": {
+            "best_acc1": best_acc1,
+            "final_acc1": final_acc1,
+            "time_to_target_s": (end or {}).get("time_to_target_s"),
+            "wall_s": (end or {}).get("wall_s"),
+            "img_per_s": img_per_s,
+            "jit_step_ms": jit_step_ms,
+            "mfu": mfu,
+            "hbm_peak_bytes": (wm or {}).get("peak_bytes"),
+            "alerts_total": len(alerts),
+            "alerts_critical": sum(
+                1 for a in alerts
+                if a.get("severity") == RUN_ENDING_SEVERITY
+            ),
+        },
+        "acc_curve": acc_curve,
+    }
+
+
+def _extract_artifact(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        d = json.load(f)
+    parsed = d.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        # BENCH_*.json shape: a bench harness line under "parsed"
+        recipe = _recipe_from_config(
+            {"dtype": parsed.get("dtype")}
+        )
+        return {
+            "source": path,
+            "format": "bench_artifact",
+            "provenance": {
+                "config_hash": None,
+                "device_kind": parsed.get("device_kind"),
+                "recipe": recipe,
+            },
+            "metrics": {
+                "img_per_s": parsed.get("value") or None,
+                "jit_step_ms": parsed.get("device_ms_per_step"),
+                "mfu": parsed.get("device_mfu"),
+                "best_acc1": None,
+                "final_acc1": None,
+                "time_to_target_s": None,
+                "wall_s": None,
+                "hbm_peak_bytes": None,
+                "alerts_total": None,
+                "alerts_critical": None,
+            },
+            "acc_curve": [],
+        }
+    if "best_val_top1" in d:
+        # ACCURACY_*.json shape
+        recipe = _recipe_from_config(d)
+        curve = d.get("val_top1_curve") or []
+        return {
+            "source": path,
+            "format": "accuracy_artifact",
+            "provenance": {
+                "config_hash": None,
+                "device_kind": d.get("device_kind"),
+                "recipe": recipe,
+            },
+            "metrics": {
+                "best_acc1": d.get("best_val_top1"),
+                "final_acc1": curve[-1] if curve else None,
+                "time_to_target_s": d.get("time_to_target_s"),
+                "wall_s": d.get("wall_seconds"),
+                "img_per_s": None,
+                "jit_step_ms": None,
+                "mfu": None,
+                "hbm_peak_bytes": None,
+                "alerts_total": None,
+                "alerts_critical": None,
+            },
+            "acc_curve": [],
+        }
+    raise ValueError(
+        f"{path!r}: not a recognized artifact (want a BENCH_*.json "
+        "'parsed' bench line or an ACCURACY_*.json with best_val_top1)"
+    )
+
+
+def extract_run(path: str) -> Dict[str, Any]:
+    """Normalize one source (run dir OR artifact JSON) into
+    ``{source, format, provenance, metrics, acc_curve}``. Directories
+    go through ``resolve_run_dir`` (which raises on a dir with no run
+    files); files must be a recognized artifact shape."""
+    if os.path.isdir(path):
+        return _extract_run_dir(path)
+    if os.path.isfile(path):
+        return _extract_artifact(path)
+    raise FileNotFoundError(f"compare source not found: {path!r}")
+
+
+def _time_to_acc(curve: List, level: float) -> Optional[float]:
+    for acc, elapsed in curve:
+        if acc >= level:
+            return elapsed
+    return None
+
+
+def _mismatches(base: Dict[str, Any], cand: Dict[str, Any]) -> List[str]:
+    """Recipe fields where BOTH sides know a value and they differ."""
+    out = []
+    br = base["provenance"]["recipe"]
+    cr = cand["provenance"]["recipe"]
+    for field in RECIPE_FIELDS:
+        b, c = br.get(field), cr.get(field)
+        if b is not None and c is not None and b != c:
+            out.append(f"{field}: {b!r} vs {c!r}")
+    return out
+
+
+def _judge(
+    name: str, direction: str, kind: str,
+    base: Optional[float], cand: Optional[float],
+    *, tol_acc_pp: float, tol_rel: float, tol_hbm: float,
+) -> Optional[Dict[str, Any]]:
+    if base is None or cand is None:
+        return None
+    base, cand = float(base), float(cand)
+    tol = {
+        "acc": tol_acc_pp,
+        "rel": tol_rel * abs(base),
+        "hbm": tol_hbm * abs(base),
+        "count": 0.0,
+    }[kind]
+    delta = round(cand - base, 6)
+    worse = -delta if direction == "higher" else delta
+    if worse > tol:
+        verdict = "regression"
+    elif worse < -tol:
+        verdict = "improvement"
+    else:
+        verdict = "ok"
+    return {
+        "metric": name,
+        "baseline": base,
+        "candidate": cand,
+        "delta": delta,
+        "tolerance": round(tol, 6),
+        "direction": direction,
+        "verdict": verdict,
+    }
+
+
+def compare_runs(
+    paths: Sequence[str],
+    *,
+    tol_acc_pp: float = 0.5,
+    tol_rel: float = 0.10,
+    tol_hbm: float = 0.05,
+    allow_mismatch: bool = False,
+) -> Dict[str, Any]:
+    """First path is the baseline; every other path is judged against
+    it. Returns the full verdict dict (strict JSON, deterministic)."""
+    if len(paths) < 2:
+        raise ValueError("compare needs a baseline and >= 1 candidate")
+    runs = [extract_run(p) for p in paths]
+    base, cands = runs[0], runs[1:]
+
+    comparisons = []
+    any_regression = False
+    any_incomparable = False
+    for cand in cands:
+        mism = _mismatches(base, cand)
+        comparable = not mism or allow_mismatch
+        metrics: List[Dict[str, Any]] = []
+        if comparable:
+            # time-to-common-accuracy: elapsed seconds to the highest
+            # top-1 BOTH runs reached — the run-vs-run version of the
+            # north-star time-to-accuracy metric
+            bb = base["metrics"].get("best_acc1")
+            cb = cand["metrics"].get("best_acc1")
+            ttca_b = ttca_c = None
+            if (
+                bb is not None and cb is not None
+                and base["acc_curve"] and cand["acc_curve"]
+            ):
+                level = min(float(bb), float(cb))
+                ttca_b = _time_to_acc(base["acc_curve"], level)
+                ttca_c = _time_to_acc(cand["acc_curve"], level)
+            for name, direction, kind in METRIC_SPECS:
+                if name == "time_to_common_acc_s":
+                    b, c = ttca_b, ttca_c
+                else:
+                    b = base["metrics"].get(name)
+                    c = cand["metrics"].get(name)
+                row = _judge(
+                    name, direction, kind, b, c,
+                    tol_acc_pp=tol_acc_pp, tol_rel=tol_rel,
+                    tol_hbm=tol_hbm,
+                )
+                if row is not None:
+                    metrics.append(row)
+        regressed = any(m["verdict"] == "regression" for m in metrics)
+        if not comparable:
+            verdict = "incomparable"
+            any_incomparable = True
+        elif regressed:
+            verdict = "regression"
+            any_regression = True
+        elif not metrics:
+            # zero shared metrics means zero validation happened — a CI
+            # gate must NOT report green for a comparison that compared
+            # nothing (e.g. an accuracy artifact against a bench
+            # artifact, or a run dir whose events are torn)
+            verdict = "no_shared_metrics"
+            any_incomparable = True
+        else:
+            verdict = "pass"
+        comparisons.append({
+            "source": cand["source"],
+            "format": cand["format"],
+            "mismatches": mism,
+            "metrics": metrics,
+            "verdict": verdict,
+        })
+
+    overall = (
+        "incomparable" if any_incomparable
+        else "regression" if any_regression
+        else "pass"
+    )
+    out = {
+        "baseline": {
+            k: base[k] for k in ("source", "format", "provenance", "metrics")
+        },
+        "tolerances": {
+            "acc_pp": tol_acc_pp,
+            "rel": tol_rel,
+            "hbm": tol_hbm,
+        },
+        "comparisons": comparisons,
+        "verdict": overall,
+    }
+    return jsonsafe(out)
+
+
+def render_comparison(result: Dict[str, Any]) -> str:
+    """The human-readable table for one compare_runs() verdict."""
+    lines = [f"== Run comparison (baseline: {result['baseline']['source']})"]
+    tol = result["tolerances"]
+    lines.append(
+        f"tolerances: acc {tol['acc_pp']:g}pp  rel {tol['rel']:.0%}  "
+        f"hbm {tol['hbm']:.0%}"
+    )
+    for comp in result["comparisons"]:
+        lines.append(f"candidate: {comp['source']}")
+        if comp["mismatches"]:
+            tag = (
+                "compared anyway (--allow-mismatch)"
+                if comp["verdict"] != "incomparable"
+                else "NOT comparable (pass --allow-mismatch to force)"
+            )
+            lines.append(f"  !! recipe mismatch — {tag}:")
+            for m in comp["mismatches"]:
+                lines.append(f"     {m}")
+        if comp["metrics"]:
+            lines.append(
+                f"  {'metric':<22} {'baseline':>12} {'candidate':>12} "
+                f"{'delta':>10}  verdict"
+            )
+            for m in comp["metrics"]:
+                mark = {
+                    "regression": "REGRESSION",
+                    "improvement": "improvement",
+                    "ok": "ok",
+                }[m["verdict"]]
+                lines.append(
+                    f"  {m['metric']:<22} {m['baseline']:>12.4g} "
+                    f"{m['candidate']:>12.4g} {m['delta']:>+10.4g}  {mark}"
+                )
+        lines.append(f"  verdict: {comp['verdict'].upper()}")
+    lines.append(f"overall verdict: {result['verdict'].upper()}")
+    return "\n".join(lines)
+
+
+__all__ = [
+    "METRIC_SPECS",
+    "RECIPE_FIELDS",
+    "compare_runs",
+    "extract_run",
+    "render_comparison",
+]

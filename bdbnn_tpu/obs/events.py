@@ -33,6 +33,14 @@ Event kinds emitted by ``fit()``:
 - ``data_error``  — a corrupt/undecodable sample was substituted
   (graceful input degradation, data/pipeline.py) instead of killing
   the run
+- ``alert``       — a health detector fired (obs/health.py): detector
+  name, severity (``critical`` = run-ending for ``summarize --strict``
+  gating), epoch/step, the observed value vs its threshold, and a
+  human message; may be followed by auto-forensics (a ``checkpoint``
+  event with reason ``forensics`` + a ``profile`` window)
+- ``health``      — run-end health summary: intervals observed, alert
+  totals (overall/critical) and per-detector counts, so consumers can
+  gate without re-scanning every alert
 - ``run_end``     — best acc/epoch, total wall seconds
 
 ``bench.py`` adds ``bench_result`` records with the same envelope.
@@ -42,6 +50,15 @@ New kinds must be registered in :data:`KNOWN_KINDS` —
 the package against it, and round-trips each kind's payload through a
 strict RFC-8259 parser, so an unregistered kind (or one smuggling NaN)
 fails CI instead of silently corrupting the channel.
+
+**Rotation.** ``events.jsonl`` is append-only and a multi-day run's
+interval events would otherwise grow it without bound. The writer takes
+a size cap (``max_bytes``; fit() wires ``--events-max-mb``): when the
+live file crosses it, it is renamed to the next ``events.<N>.jsonl``
+segment (``events.1.jsonl`` is the OLDEST) and a fresh ``events.jsonl``
+is opened. :func:`load_events` / :func:`read_events` transparently read
+the rotated segments in order, so every consumer (``summarize``,
+``watch``, ``compare``) sees one continuous timeline.
 """
 
 from __future__ import annotations
@@ -69,6 +86,8 @@ KNOWN_KINDS = frozenset(
         "restore",
         "preempt",
         "data_error",
+        "alert",
+        "health",
         "run_end",
         "bench_result",
     }
@@ -125,24 +144,71 @@ def read_jsonl(path: str) -> List[Dict[str, Any]]:
     return out
 
 
+def _rotated_segments(path: str) -> List[str]:
+    """Existing rotated segments for ``path``, oldest first
+    (``events.1.jsonl`` before ``events.2.jsonl`` — numeric order, not
+    lexicographic)."""
+    base, ext = os.path.splitext(path)
+    hits = []
+    d = os.path.dirname(path) or "."
+    if not os.path.isdir(d):
+        return []
+    prefix = os.path.basename(base) + "."
+    suffix = ext
+    for name in os.listdir(d):
+        if not (name.startswith(prefix) and name.endswith(suffix)):
+            continue
+        mid = name[len(prefix):len(name) - len(suffix)] if suffix else (
+            name[len(prefix):]
+        )
+        if mid.isdigit():
+            hits.append((int(mid), os.path.join(d, name)))
+    return [p for _, p in sorted(hits)]
+
+
 class EventWriter:
     """Append-only writer for ``<log_path>/events.jsonl``.
 
     ``emit`` is cheap host work (one json.dumps + buffered write +
     flush) — safe inside the hot loop's drain points, never between
     async dispatches.
+
+    ``max_bytes`` > 0 enables size-aware rotation: when the live file
+    crosses the cap after a write, it becomes the next ``events.<N>``
+    segment and a fresh file is opened — a multi-day run cannot fill
+    the disk with one unbounded JSONL. Records are never split across
+    segments (rotation happens between emits).
     """
 
-    def __init__(self, log_path: str, name: str = EVENTS_NAME) -> None:
+    def __init__(
+        self, log_path: str, name: str = EVENTS_NAME,
+        max_bytes: int = 0,
+    ) -> None:
         os.makedirs(log_path, exist_ok=True)
         self.path = os.path.join(log_path, name)
+        self.max_bytes = max(int(max_bytes), 0)
         self._f = open(self.path, "a")
 
     def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
         rec = jsonsafe({"t": round(time.time(), 3), "kind": kind, **fields})
         self._f.write(json.dumps(rec, default=repr) + "\n")
         self._f.flush()
+        if self.max_bytes and self._f.tell() >= self.max_bytes:
+            self._rotate()
         return rec
+
+    def _rotate(self) -> None:
+        segments = _rotated_segments(self.path)
+        base, ext = os.path.splitext(self.path)
+        if segments:
+            last = os.path.basename(segments[-1])
+            lastbase = os.path.basename(base) + "."
+            idx = int(last[len(lastbase):len(last) - len(ext)]) + 1
+        else:
+            idx = 1
+        self._f.close()
+        os.replace(self.path, f"{base}.{idx}{ext}")
+        self._f = open(self.path, "a")
 
     def close(self) -> None:
         """Idempotent: fit() closes on every exit path."""
@@ -153,11 +219,21 @@ class EventWriter:
 def read_events(
     run_dir: str, kind: Optional[str] = None
 ) -> List[Dict[str, Any]]:
-    """Load a run dir's events, optionally filtered by kind."""
-    recs = read_jsonl(os.path.join(run_dir, EVENTS_NAME))
+    """Load a run dir's events — rotated segments (oldest first) plus
+    the live file, one continuous timeline — optionally filtered by
+    kind."""
+    path = os.path.join(run_dir, EVENTS_NAME)
+    recs: List[Dict[str, Any]] = []
+    for seg in _rotated_segments(path):
+        recs += read_jsonl(seg)
+    recs += read_jsonl(path)
     if kind is None:
         return recs
     return [r for r in recs if r.get("kind") == kind]
+
+
+# the rotation-transparent loader under its contract name
+load_events = read_events
 
 
 __all__ = [
@@ -165,6 +241,7 @@ __all__ = [
     "KNOWN_KINDS",
     "EventWriter",
     "jsonsafe",
+    "load_events",
     "read_events",
     "read_jsonl",
 ]

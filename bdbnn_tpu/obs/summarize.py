@@ -21,6 +21,9 @@ debugging a finished BNN run actually asks:
   checkpoint, restart lineage from the manifest, restore provenance
   incl. ``checkpoint.old`` fallbacks, preemptions, substituted
   corrupt samples)
+- did the online health monitor fire? (the "health" section: alert
+  counts by detector, the run-ending/critical alerts ``--strict``
+  turns into a nonzero exit for CI, the run-end ``health`` roll-up)
 
 Stdlib-only: summarizing a run must never initialize a JAX backend.
 """
@@ -232,6 +235,47 @@ def _attribution(run_dir, manifest, events) -> Optional[Dict[str, Any]]:
     return out
 
 
+def _health(events) -> Optional[Dict[str, Any]]:
+    """The health-monitor section: alert counts by detector/severity,
+    the run-ending (critical) alerts `summarize --strict` gates on,
+    and the run-end ``health`` roll-up when one landed. None when the
+    run recorded no health telemetry at all."""
+    alerts = [e for e in events if e.get("kind") == "alert"]
+    roll = next(
+        (e for e in reversed(events) if e.get("kind") == "health"), None
+    )
+    if not alerts and roll is None:
+        return None
+    by_detector: Dict[str, int] = {}
+    for a in alerts:
+        det = str(a.get("detector", "?"))
+        by_detector[det] = by_detector.get(det, 0) + 1
+    critical = [
+        {
+            k: a.get(k)
+            for k in ("detector", "epoch", "step", "value", "threshold",
+                      "message")
+        }
+        for a in alerts
+        if a.get("severity") == "critical"
+    ]
+    return {
+        "alerts_total": len(alerts),
+        "alerts_critical": len(critical),
+        "by_detector": dict(sorted(by_detector.items())),
+        "critical": critical,
+        "summary_event": (
+            {
+                k: roll.get(k)
+                for k in ("intervals", "alerts_total", "alerts_critical",
+                          "by_detector")
+            }
+            if roll
+            else None
+        ),
+    }
+
+
 def _resilience(manifest, events) -> Dict[str, Any]:
     """Checkpoint/restart posture: how much work a preemption would
     cost right now, and how this run relates to its ancestors."""
@@ -320,6 +364,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     probes = _probe_trajectories(scalars, events)
     attribution = _attribution(run_dir, manifest, events)
     resilience = _resilience(manifest, events)
+    health = _health(events)
 
     summary: Dict[str, Any] = {
         "run_dir": run_dir,
@@ -346,6 +391,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
         "probes": probes,
         "attribution": attribution,
         "resilience": resilience,
+        "health": health,
         "nonfinite_intervals": len(nonfinite),
     }
     # strict JSON out the other end too: a warn-policy run's NaN
@@ -382,6 +428,27 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
             f"!! non-finite loss intervals: {len(nonfinite)} "
             f"(policy {nonfinite[0].get('policy', '?')})"
         )
+    if health:
+        if health["alerts_total"]:
+            lines.append(
+                f"health: {health['alerts_total']} alert(s) — "
+                + ", ".join(
+                    f"{k} x{v}"
+                    for k, v in health["by_detector"].items()
+                )
+                + (
+                    f"; {health['alerts_critical']} run-ending"
+                    if health["alerts_critical"]
+                    else ""
+                )
+            )
+            for a in health["critical"]:
+                lines.append(
+                    f"  !! {a['detector']} at epoch {a.get('epoch')} "
+                    f"step {a.get('step')}: {a.get('message')}"
+                )
+        else:
+            lines.append("health: monitored, no alerts")
     if tta:
         lines.append("time-to-accuracy (val top-1):")
         for r in tta:

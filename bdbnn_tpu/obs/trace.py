@@ -381,7 +381,25 @@ class TraceCapture:
     ) -> None:
         self.trace_dir = trace_dir
         self._pending = sorted(windows)
+        # user-requested (--profile-at) specs; unfired() reports only
+        # these — a dynamically schedule()d forensics window left
+        # pending (the run ended before its target point) is not a
+        # user error worth a warning
+        self._static = set(self._pending)
         self.active: Optional[Dict[str, int]] = None
+
+    def schedule(self, epoch: int, start_step: int, n_steps: int) -> None:
+        """Dynamically add a capture window mid-run — the auto-forensics
+        path (obs/health.py): an alert schedules the next ``n_steps``
+        steps so the trace holds the pathological steps themselves.
+        Callers must target a step the loop will actually run
+        (``start_step < steps_per_epoch``): a window opening on the
+        loop's final ``maybe_start`` before StopIteration would capture
+        an empty trace and emit a misleading ``profile`` event."""
+        self._pending.append(
+            (int(epoch), int(start_step), max(int(n_steps), 1))
+        )
+        self._pending.sort()
 
     def maybe_start(self, epoch: int, step: int) -> bool:
         """Open the window scheduled at this epoch with start step
@@ -405,9 +423,10 @@ class TraceCapture:
         return False
 
     def unfired(self) -> List[Tuple[int, int, int]]:
-        """Windows still pending — unreachable specs (epoch resumed
-        past, start step beyond the epoch's step count) end up here."""
-        return list(self._pending)
+        """User-requested windows still pending — unreachable specs
+        (epoch resumed past, start step beyond the epoch's step count)
+        end up here. Dynamic forensics windows are excluded."""
+        return [w for w in self._pending if w in self._static]
 
     def maybe_stop(self, epoch: int, step: int, fence=None):
         """Close the window once its step budget is traced. Returns the

@@ -6,7 +6,8 @@ the file grows: current epoch/step, last eval accuracy, flip-rate
 drift, the input-starvation flag, non-finite incidents, checkpoint
 freshness (seconds since the last committed checkpoint — the work a
 preemption RIGHT NOW would throw away — plus the run's restart count),
-and the final verdict once ``run_end`` lands. Where ``summarize`` is
+live health alerts (count by detector + seconds since the newest,
+obs/health.py), and the final verdict once ``run_end`` lands. Where ``summarize`` is
 the post-mortem, ``watch`` is the heartbeat — same files, no JAX
 backend, so it can run on a laptop against a pod run's synced log dir.
 
@@ -42,6 +43,7 @@ def render_status(
     nonfinite = [e for e in events if e.get("kind") == "nonfinite"]
     end = next((e for e in events if e.get("kind") == "run_end"), None)
     memory = [e for e in events if e.get("kind") == "memory"]
+    alerts = [e for e in events if e.get("kind") == "alert"]
     ckpts = [e for e in events if e.get("kind") == "checkpoint"]
     preempts = [e for e in events if e.get("kind") == "preempt"]
     data_errors = [e for e in events if e.get("kind") == "data_error"]
@@ -106,6 +108,26 @@ def render_status(
         )
     elif start and end is None:
         lines.append("ckpt:  NONE yet — a preemption now loses everything")
+    # live health: alert counts by detector + freshness of the newest
+    # one, right next to the checkpoint-age readout it complements
+    if alerts:
+        by: Dict[str, int] = {}
+        for a in alerts:
+            det = str(a.get("detector", "?"))
+            by[det] = by.get(det, 0) + 1
+        last_alert = alerts[-1]
+        if end is not None:
+            age_txt = "final"
+        else:
+            age_txt = (
+                f"{time.time() - float(last_alert.get('t', 0.0)):.0f}s ago"
+            )
+        lines.append(
+            f"!! alerts: {len(alerts)} ("
+            + ", ".join(f"{k} x{v}" for k, v in sorted(by.items()))
+            + f") | last {age_txt} [{last_alert.get('severity')} "
+            f"{last_alert.get('detector')}]"
+        )
     if preempts:
         p = preempts[-1]
         lines.append(

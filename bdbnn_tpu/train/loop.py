@@ -510,7 +510,9 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     # run, so even a crashed run is diagnosable post hoc (`summarize`)
     # — including restart ancestry when this run resumes another
     manifest = write_manifest(log_path, cfg, extra=_resume_lineage(cfg.resume))
-    events = EventWriter(log_path)
+    events = EventWriter(
+        log_path, max_bytes=int(cfg.events_max_mb * 2**20)
+    )
     _resources.append(events)
     logger.info(
         "telemetry: manifest.json + events.jsonl in %s (config %s)",
@@ -878,10 +880,97 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     ]
     if not windows and cfg.profile_dir:
         windows = [(0, cfg.profile_start, cfg.profile_steps)]
+    # auto-forensics schedules windows on this tracer dynamically, so
+    # it must exist (with no static windows) whenever forensics could
+    # fire — traces land where `summarize` already looks
+    forensics_on = (
+        cfg.health and cfg.health_forensics and cfg.health_max_forensics > 0
+    )
     tracer = None
-    if windows:
+    if windows or forensics_on:
         trace_dir = cfg.profile_dir or os.path.join(log_path, "profile")
         tracer = TraceCapture(trace_dir, windows)
+
+    # online health monitor: per-drain pathology detectors over the
+    # signals the drains already carry (obs/health.py)
+    health_monitor = None
+    if cfg.health:
+        from bdbnn_tpu.obs import HealthConfig, HealthMonitor
+        from bdbnn_tpu.obs import apply_health_overrides
+
+        health_monitor = HealthMonitor(
+            apply_health_overrides(HealthConfig(), cfg.health_thresholds),
+            events,
+            epochs=cfg.epochs,
+            kurt_target=cfg.w_kurtosis_target if cfg.w_kurtosis else None,
+        )
+
+    forensics_used = [0]
+
+    def _forensics(st, epoch, step_cursor, alerts):
+        """An alert fired at a drain: snapshot the live state under
+        <run_dir>/forensics/ (the main checkpoint chain is untouched)
+        and schedule a bounded trace window over the NEXT steps, so
+        the step-level evidence exists the moment the pathology does.
+        Bounded by --health-max-forensics; collective (multi-process)
+        runs skip the checkpoint (an alert-triggered Orbax save is an
+        unaligned collective — same constraint as flag-triggered
+        preemption saves) but still capture the per-host trace."""
+        if not forensics_on or forensics_used[0] >= cfg.health_max_forensics:
+            return
+        forensics_used[0] += 1
+        detector = alerts[0]["detector"]
+        tag = f"{detector}_e{epoch}_s{step_cursor}"
+        t0 = time.time()
+        path = None
+        if jax.process_count() == 1:
+            ede_t, ede_k, kg = _sched(epoch)
+            path = save_checkpoint(
+                os.path.join(log_path, "forensics", tag), st,
+                epoch=epoch, arch=cfg.arch, best_acc1=best_acc1,
+                is_best=False, step_in_epoch=step_cursor,
+                resume_state={
+                    "best_epoch": int(best_epoch),
+                    "host_rng": _pack_host_rng(),
+                    "lr_step": int(jax.device_get(st.step)),
+                    "ede_t": ede_t,
+                    "ede_k": ede_k,
+                    "kurt_gate": kg,
+                },
+            )
+            events.emit(
+                "checkpoint",
+                reason="forensics",
+                detector=detector,
+                epoch=epoch,
+                step_in_epoch=step_cursor,
+                lr_step=int(jax.device_get(st.step)),
+                path=path,
+                seconds=round(time.time() - t0, 3),
+            )
+        window_at = None
+        if tracer is not None:
+            # never schedule at/after the epoch's step count: the window
+            # would open on the loop's final maybe_start and capture an
+            # EMPTY trace whose profile event poisons the attribution
+            # (summarize/compare key on the newest trace). An alert at
+            # the epoch's last drain traces the pathology's
+            # continuation from the next epoch's first steps instead
+            # (when one exists).
+            if step_cursor < steps_per_epoch:
+                window_at = (epoch, step_cursor)
+            elif epoch + 1 < cfg.epochs:
+                window_at = (epoch + 1, 0)
+            if window_at is not None:
+                tracer.schedule(*window_at, cfg.health_forensics_steps)
+        logger.warning(
+            "auto-forensics for %s: checkpoint %s, trace window %s",
+            detector, path or "(skipped: collective run)",
+            f"{cfg.health_forensics_steps} steps from epoch "
+            f"{window_at[0]} step {window_at[1]}"
+            if window_at is not None
+            else "(skipped: run ends here)",
+        )
 
     obs = ObsHooks(
         events=events,
@@ -889,6 +978,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         probe_sizes=probe_sizes,
         nonfinite_policy=cfg.nonfinite_policy,
         tracer=tracer,
+        health=health_monitor,
+        forensics=_forensics,
     )
 
     if cfg.evaluate:
@@ -1030,8 +1121,13 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             # query per device per epoch, no device sync (memory event;
             # obs/memory.py). The post-compile poll already pinned the
             # steady-state footprint — these catch drift (fragmentation,
-            # eval-shape growth).
-            emit_memory_event(events, "epoch", jax.local_devices(), epoch=epoch)
+            # eval-shape growth), which is exactly what the hbm_creep
+            # detector watches.
+            mem_rec = emit_memory_event(
+                events, "epoch", jax.local_devices(), epoch=epoch
+            )
+            if health_monitor is not None:
+                health_monitor.observe_memory(mem_rec)
 
             is_best = acc1 > best_acc1
             if is_best:
@@ -1063,6 +1159,19 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             ),
         )
 
+    if health_monitor is not None:
+        # run-end health roll-up (the `health` event): alert totals by
+        # detector + severity, the record `summarize --strict` gates on
+        health_monitor.emit_summary()
+        if health_monitor.alerts:
+            logger.warning(
+                "run finished with %d health alert(s): %s",
+                len(health_monitor.alerts),
+                ", ".join(
+                    f"{k} x{v}"
+                    for k, v in sorted(health_monitor.counts().items())
+                ),
+            )
     events.emit(
         "run_end",
         best_acc1=best_acc1,
@@ -1099,10 +1208,12 @@ def _interval_observe(
     obs, logger, epoch, step_idx, interval_steps, sums, n, rate, probe_m
 ):
     """Drain-time telemetry: the non-finite fail-fast check, per-layer
-    probe folding, and the ``train_interval`` event. Pure host work on
-    the already-fetched float sums — no device syncs."""
+    probe folding, the ``train_interval`` event, and the health
+    monitor's detector pass. Pure host work on the already-fetched
+    float sums — no device syncs. Returns the health alerts fired (for
+    the caller's auto-forensics, which needs the live state)."""
     if obs is None:
-        return
+        return []
     bad = int(sums.get("nonfinite", 0))
     if bad:
         _apply_nonfinite_policy(
@@ -1147,6 +1258,22 @@ def _interval_observe(
             else {}
         ),
     )
+    alerts = []
+    if obs.health is not None:
+        alerts = obs.health.observe_interval(
+            epoch=epoch,
+            step=step_idx,
+            loss=sums["loss_sum"] / n,
+            img_per_s=rate,
+            flip_rate=flip_rate,
+            kurtosis=kurt,
+        )
+        for a in alerts:
+            logger.warning(
+                "HEALTH ALERT [%s] %s: %s",
+                a["severity"], a["detector"], a["message"],
+            )
+    return alerts
 
 
 def _profile_window_done(obs, logger, info):
@@ -1255,11 +1382,14 @@ def _train_epoch(
                         "compile", seconds=round(t_done - t_mark, 3)
                     )
                     # the compiled program's HBM footprint, before any
-                    # training drift (memory event; obs/memory.py)
-                    emit_memory_event(
+                    # training drift (memory event; obs/memory.py) —
+                    # also the hbm_creep detector's baseline
+                    rec = emit_memory_event(
                         obs.events, "post_compile", jax.local_devices(),
                         epoch=epoch,
                     )
+                    if obs.health is not None:
+                        obs.health.observe_memory(rec)
             if tracer is not None:
                 info = tracer.maybe_stop(epoch, step_idx, fence=fence)
                 if info is not None:
@@ -1281,10 +1411,15 @@ def _train_epoch(
                 top1_m.add(100.0 * sums["top1"] / n, n)
                 top5_m.add(100.0 * sums["top5"] / n, n)
                 rate = thr.tick(n)
-                _interval_observe(
+                alerts = _interval_observe(
                     obs, logger, epoch, step_idx, interval_steps, sums, n,
                     rate, probe_m,
                 )
+                if alerts and obs is not None and obs.forensics is not None:
+                    # the state after step step_idx corresponds to
+                    # resume cursor step_idx + 1 — the same convention
+                    # as resil.after_step
+                    obs.forensics(state, epoch, step_idx + 1, alerts)
                 progress.emit(
                     step_idx,
                     [
@@ -1339,10 +1474,12 @@ def _train_epoch(
         top1_m.add(100.0 * sums["top1"] / n, n)
         top5_m.add(100.0 * sums["top5"] / n, n)
         rate = thr.tick(n)
-        _interval_observe(
+        alerts = _interval_observe(
             obs, logger, epoch, step_idx, interval_steps, sums, n, rate,
             probe_m,
         )
+        if alerts and obs is not None and obs.forensics is not None:
+            obs.forensics(state, epoch, step_idx + 1, alerts)
     # epoch means (Appendix B #15 fix: mean, not last batch)
     writer.add_scalar("Train Loss", loss_m.mean, epoch)
     writer.add_scalar("Train Acc1", top1_m.mean, epoch)

@@ -37,7 +37,12 @@ import pytest  # noqa: E402
 _FAST_MODULES = {"test_binarize", "test_kurtosis", "test_kd", "test_cli"}
 _FAST_CLASSES = {"TestOptimizerParity", "TestEDESchedule"}
 # in fast modules but not fast: real subprocesses that import jax
-_NOT_FAST_CLASSES = {"TestSummarizeSubcommand", "TestWatchSubcommand"}
+_NOT_FAST_CLASSES = {
+    "TestSummarizeSubcommand",
+    "TestWatchSubcommand",
+    "TestSummarizeStrict",
+    "TestCompareSubcommand",
+}
 
 
 def pytest_collection_modifyitems(config, items):

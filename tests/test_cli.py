@@ -148,6 +148,36 @@ class TestTpuNativeFlags:
         with pytest.raises(ValueError, match="profile-at"):
             parse(["/data", "--profile-at", "nonsense"]).validate()
 
+    def test_health_flags(self):
+        # defaults: monitor on, forensics on, bounded
+        cfg = parse(["/data"])
+        assert cfg.health and cfg.health_forensics
+        assert cfg.health_forensics_steps == 4
+        assert cfg.health_max_forensics == 2
+        assert cfg.health_thresholds == ()
+        assert cfg.events_max_mb == 256.0
+        cfg = parse([
+            "/data", "--no-health-forensics",
+            "--health-forensics-steps", "8",
+            "--health-max-forensics", "5",
+            "--health-threshold", "loss_spike_factor=5",
+            "--health-threshold", "flip_collapse_rate=1e-6",
+            "--events-max-mb", "64",
+        ])
+        assert cfg.health and not cfg.health_forensics
+        assert cfg.health_forensics_steps == 8
+        assert cfg.health_max_forensics == 5
+        assert cfg.health_thresholds == (
+            "loss_spike_factor=5", "flip_collapse_rate=1e-6",
+        )
+        assert cfg.events_max_mb == 64.0
+        cfg.validate()
+        assert not parse(["/data", "--no-health"]).health
+        with pytest.raises(ValueError, match="health-threshold"):
+            parse(["/data", "--health-threshold", "bogus=1"]).validate()
+        with pytest.raises(ValueError, match="events-max-mb"):
+            parse(["/data", "--events-max-mb", "-1"]).validate()
+
 
 class TestSummarizeSubcommand:
     """The console entrypoint for post-hoc reports must not silently
@@ -196,6 +226,106 @@ class TestSummarizeSubcommand:
         cats = summary["attribution"]["categories_ms_per_step"]
         assert cats["binary_conv"] == pytest.approx(4.0)
         assert summary["attribution"]["hbm"]["peak_gib"] == pytest.approx(8.0)
+
+
+def _append_alert_events(run_dir):
+    """Inject one critical alert + the health roll-up into a fixture
+    run dir's event stream (what a flip-collapsed run would carry)."""
+    with open(os.path.join(run_dir, "events.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "t": 130.5, "kind": "alert", "detector": "flip_collapse",
+            "severity": "critical", "epoch": 2, "step": 3,
+            "value": 0.0, "threshold": 1e-5,
+            "message": "mean sign-flip rate 0/step < 1e-05",
+        }) + "\n")
+        f.write(json.dumps({
+            "t": 131.0, "kind": "health", "intervals": 9,
+            "alerts_total": 1, "alerts_critical": 1,
+            "by_detector": {"flip_collapse": 1},
+        }) + "\n")
+
+
+class TestSummarizeStrict:
+    """``summarize --strict``: the CI run-health gate. Exit 0 on a
+    clean run, exit 3 + a listing on stderr when a run-ending
+    (critical) alert fired."""
+
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "bdbnn_tpu.cli", "summarize", *argv],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    def test_strict_passes_clean_run(self, fixture_run_dir):
+        proc = self._run(fixture_run_dir, "--strict")
+        assert proc.returncode == 0, proc.stderr[-800:]
+
+    def test_strict_fails_on_critical_alert(self, fixture_run_dir):
+        _append_alert_events(fixture_run_dir)
+        proc = self._run(fixture_run_dir, "--strict")
+        assert proc.returncode == 3
+        assert "run-ending alert" in proc.stderr
+        assert "flip_collapse" in proc.stderr
+        # the report itself renders the health section either way
+        assert "health: 1 alert(s)" in proc.stdout
+        # without --strict the same run exits 0 (report-only)
+        proc = self._run(fixture_run_dir)
+        assert proc.returncode == 0
+        # and the --json summary carries the machine-readable section
+        proc = self._run(fixture_run_dir, "--json")
+        summary = json.loads(proc.stdout)
+        assert summary["health"]["alerts_critical"] == 1
+        assert summary["health"]["by_detector"] == {"flip_collapse": 1}
+
+
+class TestCompareSubcommand:
+    """``python -m bdbnn_tpu.cli compare`` as a real subprocess over
+    the checked-in fixture run dirs: deterministic JSON verdict, exit
+    3 on regression beyond tolerance, 0 on pass. Reads files only."""
+
+    FIXTURES = os.path.join("tests", "fixtures", "compare")
+
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "bdbnn_tpu.cli", "compare", *argv],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    def test_regression_verdict_exit_3_and_golden_json(self):
+        base = os.path.join(self.FIXTURES, "base")
+        cand = os.path.join(self.FIXTURES, "cand")
+        proc = self._run(base, cand, "--json")
+        assert proc.returncode == 3, proc.stderr[-800:]
+        result = json.loads(proc.stdout)
+        assert result["verdict"] == "regression"
+        # byte-deterministic against the checked-in golden verdict
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(repo, self.FIXTURES,
+                               "expected_verdict.json")) as f:
+            assert result == json.load(f)
+
+    def test_pass_exit_0_and_table(self):
+        base = os.path.join(self.FIXTURES, "base")
+        proc = self._run(base, base)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "overall verdict: PASS" in proc.stdout
+
+    def test_regression_table_renders(self):
+        proc = self._run(
+            os.path.join(self.FIXTURES, "base"),
+            os.path.join(self.FIXTURES, "cand"),
+        )
+        assert proc.returncode == 3
+        assert "REGRESSION" in proc.stdout
+        assert "best_acc1" in proc.stdout
+
+    def test_needs_two_paths(self):
+        proc = self._run(os.path.join(self.FIXTURES, "base"))
+        assert proc.returncode == 2  # argparse usage error
 
 
 class TestWatchSubcommand:

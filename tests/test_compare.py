@@ -1,0 +1,244 @@
+"""Cross-run regression comparison tests (obs/compare.py + the
+`compare` CLI engine): golden-output verdict over two checked-in
+fixture run dirs, provenance alignment, tolerance semantics, and the
+artifact (ACCURACY_* / BENCH_*) extraction paths."""
+
+import json
+import os
+
+import pytest
+
+from bdbnn_tpu.obs.compare import (
+    _judge,
+    compare_runs,
+    extract_run,
+    render_comparison,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join("tests", "fixtures", "compare")
+BASE = os.path.join(FIXTURES, "base")
+CAND = os.path.join(FIXTURES, "cand")
+
+
+@pytest.fixture
+def repo_cwd(monkeypatch):
+    """The golden verdict embeds the repo-relative fixture paths the
+    CLI would be invoked with."""
+    monkeypatch.chdir(REPO)
+
+
+class TestGoldenVerdict:
+    def test_matches_checked_in_golden(self, repo_cwd):
+        """THE determinism pin: compare over the two checked-in fixture
+        run dirs reproduces the checked-in verdict JSON exactly — no
+        clocks, no environment, byte-stable."""
+        result = compare_runs([BASE, CAND])
+        with open(os.path.join(REPO, FIXTURES, "expected_verdict.json")) as f:
+            expected = json.load(f)
+        assert result == expected
+
+    def test_regression_verdict_and_metrics(self, repo_cwd):
+        result = compare_runs([BASE, CAND])
+        assert result["verdict"] == "regression"
+        comp = result["comparisons"][0]
+        rows = {m["metric"]: m for m in comp["metrics"]}
+        # the fixture regresses on every shared axis
+        assert rows["best_acc1"]["verdict"] == "regression"
+        assert rows["best_acc1"]["delta"] == pytest.approx(-5.0)
+        assert rows["time_to_common_acc_s"]["baseline"] == pytest.approx(30.0)
+        assert rows["time_to_common_acc_s"]["candidate"] == pytest.approx(60.0)
+        assert rows["img_per_s"]["verdict"] == "regression"
+        assert rows["hbm_peak_bytes"]["verdict"] == "regression"
+        # the candidate's critical flip_collapse alert is a regression
+        # against an alert-free baseline
+        assert rows["alerts_critical"]["candidate"] == 1
+        assert rows["alerts_critical"]["verdict"] == "regression"
+
+    def test_self_compare_passes(self, repo_cwd):
+        result = compare_runs([BASE, BASE])
+        assert result["verdict"] == "pass"
+        assert all(
+            m["verdict"] == "ok"
+            for c in result["comparisons"]
+            for m in c["metrics"]
+        )
+
+    def test_render_text(self, repo_cwd):
+        text = render_comparison(compare_runs([BASE, CAND]))
+        assert "== Run comparison" in text
+        assert "REGRESSION" in text
+        assert "best_acc1" in text
+        assert "overall verdict: REGRESSION" in text
+
+    def test_deterministic_across_invocations(self, repo_cwd):
+        a = json.dumps(compare_runs([BASE, CAND]), sort_keys=True)
+        b = json.dumps(compare_runs([BASE, CAND]), sort_keys=True)
+        assert a == b
+
+    def test_wide_tolerances_mask_regressions(self, repo_cwd):
+        result = compare_runs(
+            [BASE, CAND], tol_acc_pp=10.0, tol_rel=2.0, tol_hbm=1.0,
+        )
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert rows["best_acc1"]["verdict"] == "ok"
+        assert rows["img_per_s"]["verdict"] == "ok"
+        # the new critical alert can never be tolerated away
+        assert rows["alerts_critical"]["verdict"] == "regression"
+        assert result["verdict"] == "regression"
+
+
+class TestJudge:
+    def test_directions_and_tolerance(self):
+        kw = dict(tol_acc_pp=0.5, tol_rel=0.1, tol_hbm=0.05)
+        assert _judge("best_acc1", "higher", "acc", 90.0, 89.0, **kw)[
+            "verdict"] == "regression"
+        assert _judge("best_acc1", "higher", "acc", 90.0, 89.8, **kw)[
+            "verdict"] == "ok"
+        assert _judge("best_acc1", "higher", "acc", 90.0, 91.0, **kw)[
+            "verdict"] == "improvement"
+        assert _judge("wall_s", "lower", "rel", 100.0, 109.0, **kw)[
+            "verdict"] == "ok"
+        assert _judge("wall_s", "lower", "rel", 100.0, 112.0, **kw)[
+            "verdict"] == "regression"
+        assert _judge("wall_s", "lower", "rel", 100.0, 80.0, **kw)[
+            "verdict"] == "improvement"
+        # a missing side -> no row at all, never a phantom verdict
+        assert _judge("mfu", "higher", "rel", None, 0.4, **kw) is None
+        assert _judge("mfu", "higher", "rel", 0.4, None, **kw) is None
+
+
+class TestAlignment:
+    def test_recipe_mismatch_is_incomparable(self, repo_cwd, tmp_path):
+        # clone the cand fixture with a different arch
+        import shutil
+
+        clone = tmp_path / "cand2"
+        shutil.copytree(os.path.join(REPO, CAND), clone)
+        man_path = clone / "manifest.json"
+        man = json.loads(man_path.read_text())
+        man["config"]["arch"] = "resnet18"
+        man_path.write_text(json.dumps(man))
+
+        result = compare_runs([BASE, str(clone)])
+        assert result["verdict"] == "incomparable"
+        comp = result["comparisons"][0]
+        assert comp["metrics"] == []  # nothing judged across recipes
+        assert any("arch" in m for m in comp["mismatches"])
+
+        forced = compare_runs([BASE, str(clone)], allow_mismatch=True)
+        assert forced["verdict"] == "regression"  # judged anyway
+        assert forced["comparisons"][0]["mismatches"]
+
+    def test_unknown_fields_do_not_mismatch(self, repo_cwd, tmp_path):
+        """Artifacts carry partial provenance: a field one side doesn't
+        know is not a mismatch."""
+        art = tmp_path / "acc.json"
+        art.write_text(json.dumps({
+            "best_val_top1": 91.0,
+            "arch": "resnet20",
+            "epochs": 3,  # matches the fixture; dataset/lr/... unknown
+        }))
+        result = compare_runs([BASE, str(art)])
+        assert result["comparisons"][0]["mismatches"] == []
+        assert result["verdict"] == "pass"  # 91.0 > 90.0 baseline
+
+
+class TestArtifactExtraction:
+    def test_accuracy_artifact(self, tmp_path):
+        art = tmp_path / "ACCURACY_x.json"
+        art.write_text(json.dumps({
+            "best_val_top1": 94.7,
+            "val_top1_curve": [10.0, 50.0, 94.7],
+            "time_to_target_s": 2235.9,
+            "wall_seconds": 2521.4,
+            "arch": "resnet20",
+            "epochs": 100,
+            "lr": 0.1,
+            "batch_size": 128,
+            "dtype": "float32",
+            "ede": True,
+        }))
+        rec = extract_run(str(art))
+        assert rec["format"] == "accuracy_artifact"
+        assert rec["metrics"]["best_acc1"] == pytest.approx(94.7)
+        assert rec["metrics"]["final_acc1"] == pytest.approx(94.7)
+        assert rec["metrics"]["time_to_target_s"] == pytest.approx(2235.9)
+        assert rec["metrics"]["wall_s"] == pytest.approx(2521.4)
+        assert rec["provenance"]["recipe"]["arch"] == "resnet20"
+
+    def test_bench_artifact(self, tmp_path):
+        art = tmp_path / "BENCH_x.json"
+        art.write_text(json.dumps({
+            "n": 5,
+            "parsed": {
+                "metric": "train_step_images_per_sec_per_chip",
+                "value": 6265.0,
+                "device_ms_per_step": 16.99,
+                "device_mfu": 0.383,
+                "device_kind": "TPU v5 lite",
+                "dtype": "bfloat16",
+            },
+        }))
+        rec = extract_run(str(art))
+        assert rec["format"] == "bench_artifact"
+        assert rec["metrics"]["img_per_s"] == pytest.approx(6265.0)
+        assert rec["metrics"]["jit_step_ms"] == pytest.approx(16.99)
+        assert rec["metrics"]["mfu"] == pytest.approx(0.383)
+        assert rec["provenance"]["device_kind"] == "TPU v5 lite"
+
+    def test_bench_vs_bench_step_ms_regression(self, tmp_path):
+        def bench(path, ms, mfu):
+            path.write_text(json.dumps({
+                "parsed": {
+                    "metric": "m", "value": 1000.0 * 17.0 / ms,
+                    "device_ms_per_step": ms, "device_mfu": mfu,
+                    "device_kind": "TPU v5 lite", "dtype": "bfloat16",
+                },
+            }))
+
+        bench(tmp_path / "a.json", 17.0, 0.38)
+        bench(tmp_path / "b.json", 22.0, 0.29)  # ~29% slower
+        result = compare_runs(
+            [str(tmp_path / "a.json"), str(tmp_path / "b.json")]
+        )
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert rows["jit_step_ms"]["verdict"] == "regression"
+        assert rows["mfu"]["verdict"] == "regression"
+        assert result["verdict"] == "regression"
+
+    def test_zero_shared_metrics_is_not_a_pass(self, tmp_path):
+        """A CI gate must not report green for a comparison that
+        compared nothing: an accuracy artifact vs a bench artifact
+        share no metric, so the verdict is incomparable (exit 2), not
+        pass (exit 0)."""
+        acc = tmp_path / "acc.json"
+        acc.write_text(json.dumps({"best_val_top1": 90.0}))
+        bench = tmp_path / "bench.json"
+        bench.write_text(json.dumps({
+            "parsed": {"metric": "m", "value": 100.0,
+                       "device_ms_per_step": 17.0},
+        }))
+        result = compare_runs([str(acc), str(bench)])
+        assert result["comparisons"][0]["verdict"] == "no_shared_metrics"
+        assert result["verdict"] == "incomparable"
+
+    def test_unrecognized_artifact_rejected(self, tmp_path):
+        bad = tmp_path / "x.json"
+        bad.write_text(json.dumps({"hello": 1}))
+        with pytest.raises(ValueError, match="not a recognized artifact"):
+            extract_run(str(bad))
+
+    def test_missing_source_rejected(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            extract_run(str(tmp_path / "nope"))
+
+    def test_needs_two_sources(self):
+        with pytest.raises(ValueError, match="baseline"):
+            compare_runs(["one"])

@@ -24,7 +24,13 @@ import os
 import numpy as np
 import pytest
 
-from bdbnn_tpu.obs.events import KNOWN_KINDS, EventWriter, jsonsafe
+from bdbnn_tpu.obs.events import (
+    KNOWN_KINDS,
+    EventWriter,
+    jsonsafe,
+    load_events,
+    read_events,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # everything that writes events: the package, plus the root-level
@@ -81,10 +87,12 @@ class TestEmitCallSites:
         )
         # the scan actually saw the package's core kinds (guards
         # against the AST walk silently matching nothing) — including
-        # the four resilience kinds, which must keep real call sites
+        # the four resilience kinds and the two health-monitor kinds,
+        # which must keep real call sites
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
-                "checkpoint", "restore", "preempt", "data_error"} <= found
+                "checkpoint", "restore", "preempt", "data_error",
+                "alert", "health"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync."""
@@ -155,3 +163,96 @@ class TestStrictRfc8259:
         assert jsonsafe(True) is True
         assert jsonsafe(0) == 0 and jsonsafe(0) is not False
         assert jsonsafe("NaN") == "NaN"  # strings pass through
+
+    def test_health_kind_payloads_roundtrip(self, tmp_path):
+        """The real alert/health payload shapes the monitor emits
+        (obs/health.py), with adversarial values in the numeric slots:
+        a NaN detector value must land as null, and the by_detector
+        dict must survive numpy counts."""
+        ev = EventWriter(str(tmp_path))
+        a = ev.emit(
+            "alert",
+            detector="flip_collapse",
+            severity="critical",
+            epoch=np.int64(3),
+            step=40,
+            value=float("nan"),
+            threshold=np.float32(1e-5),
+            message="mean sign-flip rate nan/step < 1e-05",
+        )
+        h = ev.emit(
+            "health",
+            intervals=100,
+            alerts_total=np.int64(2),
+            alerts_critical=1,
+            by_detector={"flip_collapse": np.int64(1),
+                         "loss_spike": 1},
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "alert"
+        assert lines[0]["value"] is None  # NaN -> null, never a token
+        assert lines[0]["threshold"] == pytest.approx(1e-5)
+        assert isinstance(lines[0]["epoch"], int)
+        assert lines[1]["by_detector"] == {"flip_collapse": 1,
+                                           "loss_spike": 1}
+        # the emit() return values match what was written
+        assert a["value"] is None and h["alerts_total"] == 2
+
+
+class TestRotation:
+    """Size-aware rotation (events.jsonl -> events.<N>.jsonl): a
+    multi-day run's channel is bounded per segment, and every reader
+    sees one continuous timeline through the rotation-transparent
+    loader."""
+
+    def test_writer_rotates_and_reader_reassembles(self, tmp_path):
+        w = EventWriter(str(tmp_path), max_bytes=400)
+        for i in range(30):
+            w.emit("train_interval", step=i, filler="x" * 64)
+        w.close()
+        names = sorted(os.listdir(tmp_path))
+        assert "events.jsonl" in names
+        rotated = [n for n in names if n not in ("events.jsonl",)]
+        assert rotated, "cap crossed but nothing rotated"
+        assert all(n.startswith("events.") and n.endswith(".jsonl")
+                   for n in rotated)
+        # one continuous, ordered timeline across segments
+        recs = read_events(str(tmp_path))
+        assert [r["step"] for r in recs] == list(range(30))
+        # load_events is the same rotation-transparent loader
+        assert load_events(str(tmp_path)) == recs
+        # kind filter still applies across segments
+        assert len(read_events(str(tmp_path), "train_interval")) == 30
+
+    def test_rotation_numeric_order_past_ten(self, tmp_path):
+        """Segment 10 must sort after segment 2 (numeric, not
+        lexicographic)."""
+        w = EventWriter(str(tmp_path), max_bytes=1)  # rotate every emit
+        for i in range(12):
+            w.emit("epoch", epoch=i)
+        w.close()
+        recs = read_events(str(tmp_path))
+        assert [r["epoch"] for r in recs] == list(range(12))
+
+    def test_unbounded_by_default(self, tmp_path):
+        w = EventWriter(str(tmp_path))
+        for i in range(50):
+            w.emit("epoch", epoch=i, filler="y" * 256)
+        w.close()
+        assert sorted(os.listdir(tmp_path)) == ["events.jsonl"]
+
+    def test_reopen_appends_to_live_segment(self, tmp_path):
+        """A resumed run (new EventWriter on the same dir) continues
+        the live segment and the rotation index sequence."""
+        w = EventWriter(str(tmp_path), max_bytes=300)
+        for i in range(10):
+            w.emit("epoch", epoch=i, filler="z" * 64)
+        w.close()
+        w2 = EventWriter(str(tmp_path), max_bytes=300)
+        for i in range(10, 20):
+            w2.emit("epoch", epoch=i, filler="z" * 64)
+        w2.close()
+        recs = read_events(str(tmp_path))
+        assert [r["epoch"] for r in recs] == list(range(20))

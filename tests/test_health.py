@@ -1,0 +1,426 @@
+"""Online health monitor tests (obs/health.py) + the auto-forensics
+acceptance path.
+
+Unit level: each detector's warmup/debounce/hysteresis state machine
+against synthetic pathological signal streams — injected flip collapse,
+flip explosion, kurtosis divergence, loss spike, loss plateau,
+throughput cliff, HBM creep — each firing EXACTLY its own alert and
+nothing else, plus a healthy-stream false-positive guard.
+
+End to end: a real synthetic fit() with an injected flip-rate collapse
+must produce an ``alert`` event, an auto-forensics checkpoint under
+``<run_dir>/forensics/``, and a trace window on disk (the acceptance
+criterion); a healthy seed fit must produce zero alerts.
+"""
+
+import glob
+import os
+
+import pytest
+
+from bdbnn_tpu.configs.config import RunConfig
+from bdbnn_tpu.obs.events import EventWriter, read_events
+from bdbnn_tpu.obs.health import (
+    SEVERITIES,
+    HealthConfig,
+    HealthMonitor,
+    _DetectorState,
+    apply_overrides,
+)
+
+# unit-stream config: short warmup so streams stay readable; the
+# PRODUCTION default warmup (10) is pinned separately below
+UCFG = HealthConfig(warmup_intervals=3, debounce=2)
+
+
+def _monitor(tmp_path, cfg=UCFG, epochs=10, kurt_target=None):
+    ev = EventWriter(str(tmp_path))
+    return HealthMonitor(cfg, ev, epochs=epochs, kurt_target=kurt_target), ev
+
+
+def _feed(mon, signals, epochs_at=0):
+    """Drive observe_interval over a list of signal dicts; returns the
+    list of (index, detector) firings. The default loss DECAYS — a
+    constant default would itself be a plateau."""
+    fired = []
+    for i, sig in enumerate(signals):
+        alerts = mon.observe_interval(
+            epoch=sig.get("epoch", epochs_at), step=i,
+            loss=sig.get("loss", 2.3 - 0.05 * i),
+            img_per_s=sig.get("img_per_s", 100.0),
+            flip_rate=sig.get("flip_rate", {"a": 1e-3}),
+            kurtosis=sig.get("kurtosis", {"a": 2.5}),
+        )
+        fired += [(i, a["detector"]) for a in alerts]
+    return fired
+
+
+class TestDetectorState:
+    def test_warmup_swallows_early_breaches(self):
+        st = _DetectorState(warmup=3, debounce=1)
+        assert [st.update(True) for _ in range(3)] == [False] * 3
+        assert st.update(True) is True  # first post-warmup breach
+
+    def test_debounce_needs_consecutive_breaches(self):
+        st = _DetectorState(warmup=0, debounce=3)
+        assert not st.update(True)
+        assert not st.update(True)
+        assert not st.update(False)  # streak reset
+        assert not st.update(True)
+        assert not st.update(True)
+        assert st.update(True)  # 3 consecutive
+
+    def test_hysteresis_latches_until_recovery(self):
+        st = _DetectorState(warmup=0, debounce=1)
+        assert st.update(True)
+        # still breaching: latched, no second alert
+        assert not st.update(True)
+        assert not st.update(True)
+        # recovery re-arms; next sustained breach fires again
+        assert not st.update(False, recovered=True)
+        assert st.update(True)
+        assert st.fired == 2
+
+
+class TestOverrides:
+    def test_apply_and_types(self):
+        cfg = apply_overrides(
+            HealthConfig(), ["loss_spike_factor=5.5", "loss_window=4"]
+        )
+        assert cfg.loss_spike_factor == 5.5
+        assert cfg.loss_window == 4 and isinstance(cfg.loss_window, int)
+
+    def test_unknown_name_rejected(self):
+        with pytest.raises(ValueError, match="health-threshold"):
+            apply_overrides(HealthConfig(), ["not_a_knob=1"])
+        with pytest.raises(ValueError, match="health-threshold"):
+            apply_overrides(HealthConfig(), ["loss_window=soon"])
+
+    def test_config_validate_rejects_bad_threshold(self):
+        cfg = RunConfig(synthetic=True, health_thresholds=("nope=1",))
+        with pytest.raises(ValueError, match="health-threshold"):
+            cfg.validate()
+
+    def test_production_warmup_default(self):
+        # smoke-scale fits (< ~10 drains) must end before any flip/kurt
+        # detector becomes eligible — that is the false-positive guard
+        # for the whole existing test suite
+        assert HealthConfig().warmup_intervals == 10
+
+
+class TestDetectorStreams:
+    """Each injected pathology fires exactly its own detector."""
+
+    def test_flip_collapse_only(self, tmp_path):
+        mon, ev = _monitor(tmp_path)
+        fired = _feed(mon, [{"flip_rate": {"a": 0.0}}] * 8)
+        # warmup 3 + debounce 2 -> fires at the 5th observation, once
+        assert fired == [(4, "flip_collapse")]
+        ev.close()
+        recs = read_events(str(tmp_path), "alert")
+        assert len(recs) == 1 and recs[0]["severity"] == "critical"
+
+    def test_flip_collapse_not_near_run_end(self, tmp_path):
+        # a run at 95% of its epoch budget is ALLOWED to freeze: that
+        # is convergence, not collapse
+        mon, _ = _monitor(tmp_path, epochs=100)
+        fired = _feed(mon, [{"flip_rate": {"a": 0.0}, "epoch": 95}] * 8)
+        assert fired == []
+
+    def test_flip_explosion_only(self, tmp_path):
+        mon, _ = _monitor(tmp_path)
+        fired = _feed(mon, [{"flip_rate": {"a": 0.4}}] * 8)
+        assert fired == [(4, "flip_explosion")]
+
+    def test_hysteresis_one_alert_for_hovering_signal(self, tmp_path):
+        mon, _ = _monitor(tmp_path)
+        # collapse for 10 drains, recover (> 2x threshold), collapse again
+        stream = (
+            [{"flip_rate": {"a": 0.0}}] * 10
+            + [{"flip_rate": {"a": 1e-3}}] * 2
+            + [{"flip_rate": {"a": 0.0}}] * 3
+        )
+        fired = _feed(mon, stream)
+        # re-fires after recovery: debounce 2 over indices 12-13
+        assert fired == [(4, "flip_collapse"), (13, "flip_collapse")]
+
+    def test_kurt_divergence_needs_target(self, tmp_path):
+        stream = [{"kurtosis": {"a": 50.0}}] * 8
+        mon, _ = _monitor(tmp_path)  # kurtosis loss off -> disarmed
+        assert _feed(mon, stream) == []
+        mon, _ = _monitor(tmp_path / "t", kurt_target=1.8)
+        assert _feed(mon, stream) == [(4, "kurt_divergence")]
+
+    def test_loss_spike_only(self, tmp_path):
+        mon, _ = _monitor(tmp_path)
+        # jittered baseline (so it is not ALSO a plateau), one 4.5x spike
+        base = [{"loss": 2.0 + (0.1 if i % 2 else -0.1)} for i in range(6)]
+        fired = _feed(mon, base + [{"loss": 9.0}] + base[:3])
+        assert fired == [(6, "loss_spike")]
+
+    def test_loss_plateau_only_at_high_loss(self, tmp_path):
+        mon, _ = _monitor(tmp_path)
+        fired = _feed(mon, [{"loss": 2.3}] * 8)
+        # plateau_window 6 -> fires as soon as 6 flat high-loss drains
+        # exist (early in training: epoch 0 of 10)
+        assert fired == [(5, "loss_plateau")]
+        # a plateau at ~zero loss is convergence, not pathology
+        mon, _ = _monitor(tmp_path / "low")
+        assert _feed(mon, [{"loss": 0.01}] * 8) == []
+
+    def test_throughput_cliff_only(self, tmp_path):
+        mon, _ = _monitor(tmp_path)
+        stream = [{"img_per_s": 1000.0}] * 9 + [{"img_per_s": 200.0}] * 2
+        fired = _feed(mon, stream)
+        # needs 8 history + debounce 2 -> second cliff interval fires
+        assert fired == [(10, "throughput_regression")]
+
+    def test_hbm_creep_fires_once(self, tmp_path):
+        mon, ev = _monitor(tmp_path)
+        assert mon.observe_memory({"peak_bytes": 10 * 2**30}) == []  # baseline
+        assert mon.observe_memory({"peak_bytes": 10 * 2**30}) == []
+        out = mon.observe_memory({"peak_bytes": 12 * 2**30, "epoch": 3})
+        assert [a["detector"] for a in out] == ["hbm_creep"]
+        # latched: further creep does not re-alert
+        assert mon.observe_memory({"peak_bytes": 14 * 2**30}) == []
+        assert mon.observe_memory({"available": False, "peak_bytes": None}) == []
+
+    def test_healthy_stream_no_alerts(self, tmp_path):
+        """False-positive guard: a healthy run's signals — decaying
+        loss, settling (but nonzero) flips, near-target kurtosis,
+        steady throughput with realistic jitter — fire nothing."""
+        mon, ev = _monitor(tmp_path, kurt_target=1.8)
+        stream = [
+            {
+                "loss": 2.3 * (0.97 ** i),
+                "img_per_s": 1000.0 + (-30.0 if i % 3 else 40.0),
+                "flip_rate": {"a": 1e-2 / (1 + i), "b": 5e-3},
+                "kurtosis": {"a": 2.8 - 0.05 * i, "b": 2.2},
+                "epoch": i // 4,
+            }
+            for i in range(24)
+        ]
+        assert _feed(mon, stream) == []
+        mon.observe_memory({"peak_bytes": 8 * 2**30})
+        assert mon.observe_memory({"peak_bytes": 8 * 2**30 + 2**20}) == []
+        summary = mon.emit_summary()
+        assert summary["alerts_total"] == 0
+        ev.close()
+        assert read_events(str(tmp_path), "alert") == []
+
+    def test_summary_event_counts(self, tmp_path):
+        mon, ev = _monitor(tmp_path)
+        _feed(mon, [{"flip_rate": {"a": 0.0}}] * 6)
+        rec = mon.emit_summary()
+        assert rec["kind"] == "health"
+        assert rec["alerts_total"] == 1
+        assert rec["alerts_critical"] == 1
+        assert rec["by_detector"] == {"flip_collapse": 1}
+        ev.close()
+        assert read_events(str(tmp_path), "health") == [rec]
+
+    def test_severity_table_covers_all_detectors(self):
+        assert set(SEVERITIES.values()) <= {"critical", "warning"}
+        for det in ("flip_collapse", "flip_explosion", "loss_spike"):
+            assert SEVERITIES[det] == "critical"
+
+
+def _find_run_dir(root):
+    hits = glob.glob(os.path.join(str(root), "**", "events.jsonl"),
+                     recursive=True)
+    assert hits, f"no events.jsonl under {root}"
+    return os.path.dirname(sorted(hits)[-1])
+
+
+@pytest.fixture(scope="module")
+def collapsed_run(tmp_path_factory):
+    """ONE synthetic fit with an injected flip-rate collapse (the probe
+    drain is patched to report zero flips), health on, forensics on:
+    the acceptance-criterion run shared by the assertions below.
+    Throughput detection is disabled via threshold override — the
+    forensics trace capture itself slows the traced steps, which is
+    exactly the kind of measurement perturbation that must not turn
+    into a second alert inside this test."""
+    import bdbnn_tpu.train.loop as loop_mod
+    from bdbnn_tpu.train.loop import fit
+
+    tmp = tmp_path_factory.mktemp("healthrun")
+    orig = loop_mod.drain_probe_report
+    loop_mod.drain_probe_report = (
+        lambda sums, sizes, steps: ({"layer": 0.0}, {"layer": 2.5})
+    )
+    try:
+        res = fit(RunConfig(
+            dataset="cifar10",
+            synthetic=True,
+            synthetic_train_size=1024,  # 16 steps
+            synthetic_val_size=64,
+            arch="resnet8_tiny",
+            epochs=1,
+            batch_size=64,
+            lr=0.05,
+            print_freq=1,
+            log_path=str(tmp / "log"),
+            seed=0,
+            workers=2,
+            health_forensics_steps=3,
+            health_thresholds=("throughput_window=999",),
+        ))
+    finally:
+        loop_mod.drain_probe_report = orig
+    return {"res": res, "run_dir": _find_run_dir(tmp)}
+
+
+class TestFitHealthEndToEnd:
+    def test_alert_event_fired(self, collapsed_run):
+        alerts = read_events(collapsed_run["run_dir"], "alert")
+        assert alerts, "injected flip collapse fired no alert"
+        assert {a["detector"] for a in alerts} == {"flip_collapse"}
+        a = alerts[0]
+        assert a["severity"] == "critical"
+        # warmup 10 + debounce 2 -> the 12th drain (step index 11)
+        assert a["step"] == 11
+        assert a["value"] == 0.0 and a["threshold"] == pytest.approx(1e-5)
+
+    def test_forensics_checkpoint_on_disk(self, collapsed_run):
+        run_dir = collapsed_run["run_dir"]
+        ck = [e for e in read_events(run_dir, "checkpoint")
+              if e.get("reason") == "forensics"]
+        assert len(ck) == 1
+        assert ck[0]["detector"] == "flip_collapse"
+        assert os.path.isdir(ck[0]["path"])
+        assert ck[0]["path"].startswith(os.path.join(run_dir, "forensics"))
+        # a real, restorable checkpoint: payload + integrity + sidecar
+        for name in ("INTEGRITY.json", "resume.json"):
+            assert os.path.exists(os.path.join(ck[0]["path"], name))
+
+    def test_forensics_trace_window_on_disk(self, collapsed_run):
+        from bdbnn_tpu.obs import find_trace_file
+
+        run_dir = collapsed_run["run_dir"]
+        prof = read_events(run_dir, "profile")
+        assert len(prof) == 1
+        # scheduled at the alert's resume cursor (step 12), 3 steps
+        assert prof[0]["epoch"] == 0 and prof[0]["start_step"] == 12
+        assert prof[0]["steps"] == 3
+        assert find_trace_file(run_dir), "no forensics trace on disk"
+
+    def test_health_summary_event(self, collapsed_run):
+        health = read_events(collapsed_run["run_dir"], "health")
+        assert len(health) == 1
+        assert health[0]["alerts_critical"] == 1
+        assert health[0]["by_detector"] == {"flip_collapse": 1}
+
+    def test_summarize_renders_health_and_strict_gates(self, collapsed_run):
+        from bdbnn_tpu.obs import summarize_run
+
+        report, summary = summarize_run(collapsed_run["run_dir"])
+        assert summary["health"]["alerts_critical"] == 1
+        assert summary["health"]["by_detector"] == {"flip_collapse": 1}
+        assert "health:" in report and "flip_collapse" in report
+        assert "!! flip_collapse" in report
+
+    def test_watch_highlights_alerts(self, collapsed_run):
+        from bdbnn_tpu.obs.manifest import read_manifest
+        from bdbnn_tpu.obs.watch import render_status
+
+        run_dir = collapsed_run["run_dir"]
+        out = render_status(read_events(run_dir), read_manifest(run_dir))
+        assert "!! alerts: 1 (flip_collapse x1)" in out
+        assert "critical flip_collapse" in out
+
+
+class TestForensicsAtEpochEnd:
+    def test_alert_on_final_drain_skips_empty_trace(self, tmp_path):
+        """An alert at the epoch's LAST drain must not open a trace
+        window the loop can never feed: an empty capture's `profile`
+        event would poison summarize/compare attribution (they key on
+        the newest trace). The checkpoint still lands; the trace is
+        skipped when no steps remain in the run."""
+        import bdbnn_tpu.train.loop as loop_mod
+        from bdbnn_tpu.obs import find_trace_file
+        from bdbnn_tpu.train.loop import fit
+
+        orig = loop_mod.drain_probe_report
+        loop_mod.drain_probe_report = (
+            lambda sums, sizes, steps: ({"layer": 0.0}, {"layer": 2.5})
+        )
+        try:
+            fit(RunConfig(
+                dataset="cifar10",
+                synthetic=True,
+                synthetic_train_size=768,  # 12 steps: warmup 10 +
+                synthetic_val_size=64,     # debounce 2 fire on the last
+                arch="resnet8_tiny",
+                epochs=1,
+                batch_size=64,
+                lr=0.05,
+                print_freq=1,
+                log_path=str(tmp_path / "log"),
+                seed=0,
+                workers=2,
+                health_thresholds=("throughput_window=999",),
+            ))
+        finally:
+            loop_mod.drain_probe_report = orig
+        run_dir = _find_run_dir(tmp_path)
+        alerts = read_events(run_dir, "alert")
+        assert [a["detector"] for a in alerts] == ["flip_collapse"]
+        assert alerts[0]["step"] == 11  # the epoch's final drain
+        # forensics checkpoint still lands...
+        ck = [e for e in read_events(run_dir, "checkpoint")
+              if e.get("reason") == "forensics"]
+        assert len(ck) == 1 and os.path.isdir(ck[0]["path"])
+        # ...but no empty capture: no profile event, no trace file
+        assert read_events(run_dir, "profile") == []
+        assert find_trace_file(run_dir) is None
+
+
+class TestHealthyFitNoAlerts:
+    def test_healthy_seed_run_fires_nothing(self, tmp_path):
+        """End-to-end false-positive guard: a healthy (default-config)
+        synthetic fit with real probes emits zero alerts and a clean
+        health roll-up."""
+        from bdbnn_tpu.train.loop import fit
+
+        fit(RunConfig(
+            dataset="cifar10",
+            synthetic=True,
+            synthetic_train_size=512,  # 8 steps
+            synthetic_val_size=64,
+            arch="resnet8_tiny",
+            epochs=1,
+            batch_size=64,
+            lr=0.05,
+            print_freq=2,
+            log_path=str(tmp_path / "log"),
+            seed=0,
+            workers=2,
+        ))
+        run_dir = _find_run_dir(tmp_path)
+        assert read_events(run_dir, "alert") == []
+        health = read_events(run_dir, "health")
+        assert len(health) == 1 and health[0]["alerts_total"] == 0
+        # no forensics artifacts for a clean run
+        assert not os.path.isdir(os.path.join(run_dir, "forensics"))
+
+    def test_no_health_flag_disables_monitor(self, tmp_path):
+        from bdbnn_tpu.train.loop import fit
+
+        fit(RunConfig(
+            dataset="cifar10",
+            synthetic=True,
+            synthetic_train_size=128,
+            synthetic_val_size=64,
+            arch="resnet8_tiny",
+            epochs=1,
+            batch_size=64,
+            print_freq=2,
+            log_path=str(tmp_path / "log"),
+            seed=0,
+            workers=2,
+            health=False,
+        ))
+        run_dir = _find_run_dir(tmp_path)
+        assert read_events(run_dir, "health") == []
+        assert read_events(run_dir, "alert") == []
