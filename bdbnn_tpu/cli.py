@@ -20,6 +20,21 @@ import sys
 from bdbnn_tpu.configs.config import RunConfig
 
 
+def _force_jax_platforms() -> None:
+    """An explicit JAX_PLATFORMS env var must win even when a
+    PJRT-plugin sitecustomize already forced jax_platforms via
+    jax.config.update (config updates silently shadow the env var; a
+    user asking for JAX_PLATFORMS=cpu would otherwise block on
+    remote-TPU init). Every backend-touching subcommand calls this
+    before its first real jax use."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="BD-BNN TPU training")
     p.add_argument("data", nargs="?", default="", help="dataset directory")
@@ -371,8 +386,9 @@ def compare_main(argv) -> int:
     )
     ap.add_argument(
         "paths", nargs="+", metavar="RUN",
-        help="baseline first, then candidate run dir(s) or "
-        "BENCH_*/ACCURACY_* artifact JSONs",
+        help="baseline first, then candidate run dir(s) — training or "
+        "serve-bench — or artifact JSONs (BENCH_*/ACCURACY_*/serve "
+        "verdict.json)",
     )
     ap.add_argument(
         "--json", action="store_true",
@@ -449,31 +465,273 @@ def watch_main(argv) -> int:
     return watch_run(run_dir, interval=args.interval, once=args.once)
 
 
+def export_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli export RUN_DIR -o ARTIFACT_DIR`` —
+    freeze a training checkpoint into a deployment artifact: weights
+    binarized once (packed sign + per-channel alpha), BatchNorm folded
+    to per-channel scale/bias, EDE/optimizer/latent training state
+    stripped, strict-JSON ``artifact.json`` provenance. Records an
+    ``export`` event on the source run's timeline."""
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli export",
+        description="Freeze a run dir's checkpoint (model_best "
+        "preferred) into a serving artifact.",
+    )
+    ap.add_argument("source", help="run dir or checkpoint dir")
+    ap.add_argument("-o", "--out", required=True, help="artifact dir")
+    ap.add_argument(
+        "--arch", default=None,
+        help="override the arch recorded in the run manifest",
+    )
+    ap.add_argument(
+        "--dataset", default=None,
+        choices=["cifar10", "cifar100", "imagenet"],
+        help="override the dataset recorded in the run manifest",
+    )
+    args = ap.parse_args(argv)
+
+    _force_jax_platforms()  # the orbax restore initializes the backend
+
+    from bdbnn_tpu.serve.export import export_artifact
+
+    artifact = export_artifact(
+        args.source, args.out, arch=args.arch, dataset=args.dataset
+    )
+    print(json.dumps(
+        {
+            "artifact": args.out,
+            "arch": artifact["arch"],
+            "dataset": artifact["dataset"],
+            "binarized_convs": artifact["stats"]["binarized_convs"],
+            "compression_ratio": artifact["stats"]["compression_ratio"],
+            "checkpoint_acc1": artifact["eval"]["checkpoint_acc1"],
+            "integrity": artifact["checkpoint"]["integrity"],
+        },
+        indent=2, sort_keys=True,
+    ))
+    return 0
+
+
+def predict_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli predict ARTIFACT [DATA]`` — offline
+    batch inference over a dataset split through the bucketed engine;
+    reports top-1 against the artifact's recorded checkpoint accuracy.
+    ``--check`` exits 3 when they differ (the export-fidelity gate)."""
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli predict",
+        description="Run an export artifact over a val split and "
+        "report top-1.",
+    )
+    ap.add_argument("artifact", help="export artifact dir")
+    ap.add_argument("data", nargs="?", default="", help="dataset dir")
+    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--synthetic-val-size", type=int, default=None)
+    ap.add_argument("-b", "--batch-size", type=int, default=None)
+    ap.add_argument(
+        "--check", action="store_true",
+        help="exit 3 unless top-1 matches the recorded checkpoint "
+        "accuracy within --check-tol",
+    )
+    ap.add_argument(
+        "--check-tol", type=float, default=0.0, metavar="PP",
+        help="--check tolerance in percentage points (default 0 = "
+        "exact, what the smoke-scale fidelity test pins; on full-size "
+        "val splits the folded-BN forward matches to fp32 rounding, so "
+        "a borderline argmax tie can move top-1 by one sample — give "
+        "CI a hair of slack, e.g. 0.05)",
+    )
+    args = ap.parse_args(argv)
+
+    import dataclasses as _dc
+
+    _force_jax_platforms()
+
+    from bdbnn_tpu.serve.engine import InferenceEngine, evaluate_split
+    from bdbnn_tpu.serve.export import read_artifact
+    from bdbnn_tpu.train.loop import build_datasets
+
+    artifact = read_artifact(args.artifact)
+    # the val split is rebuilt with the TRAINING run's own config (seed,
+    # sizes, normalization) so the reported top-1 is comparable — CLI
+    # flags override data location and smoke-scale knobs only
+    cfg_dict = dict(artifact.get("provenance", {}).get("config") or {})
+    fields = {f.name for f in _dc.fields(RunConfig)}
+    cfg_kwargs = {}
+    for k, v in cfg_dict.items():
+        if k in fields:
+            cfg_kwargs[k] = tuple(v) if isinstance(v, list) else v
+    cfg_kwargs["arch"] = artifact["arch"]
+    cfg_kwargs["dataset"] = artifact["dataset"]
+    if args.data:
+        cfg_kwargs["data"] = args.data
+    if args.synthetic:
+        cfg_kwargs["synthetic"] = True
+    if args.synthetic_val_size is not None:
+        cfg_kwargs["synthetic_val_size"] = args.synthetic_val_size
+    if args.batch_size is not None:
+        cfg_kwargs["batch_size"] = args.batch_size
+    cfg = RunConfig(**cfg_kwargs)
+
+    _, val_pipe, _ = build_datasets(cfg, val_only=True)
+    batch = val_pipe.batch_size
+    engine = InferenceEngine(args.artifact, buckets=(batch,))
+    try:
+        result = evaluate_split(engine, val_pipe)
+    finally:
+        close = getattr(val_pipe, "close", None)
+        if callable(close):
+            close()
+    recorded = artifact.get("eval", {}).get("checkpoint_acc1")
+    out = {
+        "artifact": args.artifact,
+        "arch": artifact["arch"],
+        "dataset": artifact["dataset"],
+        "top1": result["top1"],
+        "correct": result["correct"],
+        "count": result["count"],
+        "recorded_checkpoint_acc1": recorded,
+        "match": (
+            None
+            if recorded is None
+            else abs(result["top1"] - recorded) <= args.check_tol
+        ),
+    }
+    print(json.dumps(out, indent=2, sort_keys=True))
+    if args.check:
+        if recorded is None:
+            # a rolling-checkpoint export records no per-checkpoint
+            # accuracy — there is nothing to check against; distinct
+            # exit code so CI does not mistake this for a pass OR a
+            # fidelity regression
+            print(
+                "[predict --check] artifact was exported from a rolling "
+                "checkpoint (no model_best) and records no "
+                "per-checkpoint accuracy; nothing to check",
+                file=sys.stderr,
+            )
+            return 2
+        if not out["match"]:
+            print(
+                f"[predict --check] top-1 {result['top1']} != recorded "
+                f"{recorded} (tol {args.check_tol}pp)",
+                file=sys.stderr,
+            )
+            return 3
+    return 0
+
+
+def serve_bench_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli serve-bench ARTIFACT [flags]`` — the
+    SLO benchmark: AOT-warmed bucketed engine behind the bounded
+    micro-batcher, driven closed- or open-loop (Poisson); emits
+    ``serve`` events into a run dir and prints the strict-JSON verdict.
+    SIGTERM drains cleanly (every accepted request answered) before the
+    verdict is written."""
+    import json
+
+    from bdbnn_tpu.configs.config import ServeBenchConfig
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli serve-bench",
+        description="Benchmark an export artifact against an SLO: "
+        "p50/p95/p99 latency, throughput, batch occupancy, shed rate.",
+    )
+    ap.add_argument("artifact", help="export artifact dir")
+    ap.add_argument("--log-path", default="serve_log")
+    ap.add_argument("--mode", default="open", choices=["open", "closed"])
+    ap.add_argument(
+        "--rate", type=float, default=100.0,
+        help="open-loop Poisson arrival rate, req/s (default 100)",
+    )
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument(
+        "--concurrency", type=int, default=4,
+        help="closed-loop in-flight requests (default 4)",
+    )
+    ap.add_argument(
+        "--buckets", type=int, nargs="+", default=[1, 8, 32],
+        help="batch-size buckets AOT-compiled at startup",
+    )
+    ap.add_argument(
+        "--queue-depth", type=int, default=128,
+        help="bounded request queue; beyond it requests are SHED",
+    )
+    ap.add_argument(
+        "--max-delay-ms", type=float, default=5.0,
+        help="micro-batch coalescing deadline (default 5ms)",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--out", default="",
+        help="also write the SLO verdict JSON here",
+    )
+    ap.add_argument(
+        "--events-max-mb", type=float, default=256.0,
+        help="rotate the serve run's events.jsonl past this size in "
+        "MiB (default 256; 0 = unbounded) — same knob as training",
+    )
+    args = ap.parse_args(argv)
+
+    _force_jax_platforms()
+
+    from bdbnn_tpu.serve.loadgen import run_serve_bench
+
+    cfg = ServeBenchConfig(
+        artifact=args.artifact,
+        log_path=args.log_path,
+        mode=args.mode,
+        rate=args.rate,
+        requests=args.requests,
+        concurrency=args.concurrency,
+        buckets=tuple(args.buckets),
+        queue_depth=args.queue_depth,
+        max_delay_ms=args.max_delay_ms,
+        seed=args.seed,
+        out=args.out,
+        events_max_mb=args.events_max_mb,
+    )
+    result = run_serve_bench(cfg)
+    print(json.dumps(result["verdict"], indent=2, sort_keys=True))
+    print(f"[serve-bench] run dir: {result['run_dir']}", file=sys.stderr)
+    failed = result["verdict"].get("requests_failed") or 0
+    if failed:
+        # hard inference failures are not load shedding and must not
+        # exit 0 — a broken artifact/engine would otherwise read as a
+        # healthy (if shed-heavy) benchmark
+        print(
+            f"[serve-bench] {failed} request(s) FAILED with engine "
+            "errors (not shed); see the run dir's events",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+_SUBCOMMANDS = {
+    "summarize": summarize_main,
+    "watch": watch_main,
+    "compare": compare_main,
+    "export": export_main,
+    "predict": predict_main,
+    "serve-bench": serve_bench_main,
+}
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     # subcommand dispatch ahead of the reference-compatible flag surface
-    # (a dataset dir named "summarize"/"watch"/"compare" would shadow
-    # it — none does)
-    if argv and argv[0] == "summarize":
-        return summarize_main(argv[1:])
-    if argv and argv[0] == "watch":
-        return watch_main(argv[1:])
-    if argv and argv[0] == "compare":
-        return compare_main(argv[1:])
+    # (a dataset dir named like a subcommand would shadow it — none does)
+    if argv and argv[0] in _SUBCOMMANDS:
+        return _SUBCOMMANDS[argv[0]](argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
 
-    # An explicit JAX_PLATFORMS env var must win even when a PJRT-plugin
-    # sitecustomize already forced jax_platforms via jax.config.update
-    # (config updates silently shadow the env var; a user asking for
-    # JAX_PLATFORMS=cpu would otherwise block on remote-TPU init).
-    import os
-
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    _force_jax_platforms()
 
     from bdbnn_tpu.train.loop import fit
     from bdbnn_tpu.train.resilience import PREEMPT_EXIT_CODE, PreemptedError

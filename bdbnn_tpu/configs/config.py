@@ -227,3 +227,59 @@ class RunConfig:
                 "point it at a local torchvision .pth checkpoint)"
             )
         return self
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeBenchConfig:
+    """Typed configuration of the ``serve-bench`` CLI (serve/loadgen.py).
+
+    Mirrors RunConfig's resolve-once contract: everything the serving
+    stack needs — engine buckets, batcher bounds, load model — is
+    validated here before any backend or thread exists, so a bad knob
+    fails at the command line, not mid-benchmark.
+    """
+
+    artifact: str  # export artifact dir (serve/export.py)
+    log_path: str = "serve_log"  # run dirs (manifest + serve events) land here
+    # load model: "open" = Poisson arrivals at `rate` req/s (offered
+    # load independent of completions — the production shape, exercises
+    # shedding); "closed" = `concurrency` workers, one request in
+    # flight each (sustainable-throughput probe)
+    mode: str = "open"
+    rate: float = 100.0
+    requests: int = 200
+    concurrency: int = 4
+    # engine batch-size buckets, AOT-compiled at startup; the largest
+    # is also the micro-batcher's coalescing target
+    buckets: Tuple[int, ...] = (1, 8, 32)
+    # bounded request queue: beyond this, submits are shed (explicit
+    # rejection), never queued without bound
+    queue_depth: int = 128
+    # coalescing deadline: a batch never waits past this from its first
+    # request's enqueue
+    max_delay_ms: float = 5.0
+    seed: int = 0
+    out: str = ""  # also write the SLO verdict JSON here
+    events_max_mb: float = 256.0
+
+    def validate(self) -> "ServeBenchConfig":
+        if not self.artifact:
+            raise ValueError("serve-bench needs an export artifact dir")
+        if self.mode not in ("open", "closed"):
+            raise ValueError(f"unknown load mode {self.mode!r} (open|closed)")
+        if not self.buckets or any(b <= 0 for b in self.buckets):
+            raise ValueError(
+                f"--buckets must be positive ints, got {self.buckets!r}"
+            )
+        if self.queue_depth <= 0:
+            raise ValueError("--queue-depth must be >= 1 (the bound IS the "
+                             "shedding point)")
+        if self.requests <= 0 or self.concurrency <= 0:
+            raise ValueError("--requests and --concurrency must be positive")
+        if self.mode == "open" and self.rate <= 0:
+            raise ValueError("open-loop mode needs --rate > 0 (req/s)")
+        if self.max_delay_ms < 0:
+            raise ValueError("--max-delay-ms must be >= 0")
+        if self.events_max_mb < 0:
+            raise ValueError("--events-max-mb must be >= 0")
+        return self

@@ -1,10 +1,13 @@
 from bdbnn_tpu.models import cifar10, imagenet, registry, resnet, torch_import
 from bdbnn_tpu.models.registry import create_model, list_models
 from bdbnn_tpu.models.resnet import (
+    BN_EPS,
     BiBasicBlock,
     BiResNet,
     VGGSmallBinary,
+    bn_identity_stats,
     conv_weight_paths,
+    fold_batch_norm,
     get_by_path,
     module_path_str,
 )
@@ -21,10 +24,13 @@ __all__ = [
     "torch_import",
     "create_model",
     "list_models",
+    "BN_EPS",
     "BiBasicBlock",
     "BiResNet",
     "VGGSmallBinary",
+    "bn_identity_stats",
     "conv_weight_paths",
+    "fold_batch_norm",
     "get_by_path",
     "module_path_str",
     "convert_torch_state_dict",

@@ -423,6 +423,99 @@ class VGGSmallBinary(nn.Module):
 
 
 # ---------------------------------------------------------------------------
+# BatchNorm folding (serve-time eval apply)
+# ---------------------------------------------------------------------------
+
+# every _batch_norm() in this module uses eps 1e-5 (torch parity); the
+# fold below must add back exactly this value
+BN_EPS = 1e-5
+
+
+def _bn_identity_var(eps: float = BN_EPS):
+    """The running-variance value that makes flax's eval BatchNorm an
+    exact per-channel affine: with ``var = 1 - eps`` the in-graph
+    ``rsqrt(var + eps)`` computes ``rsqrt(f32(1 - eps) + f32(eps))``,
+    which rounds to exactly 1.0 in float32 — so the folded ``scale`` and
+    ``bias`` pass through unscaled."""
+    import numpy as np
+
+    return np.float32(1.0) - np.float32(eps)
+
+
+def bn_identity_stats(channels: int, eps: float = BN_EPS):
+    """Identity running stats (``mean`` 0, ``var`` 1-eps) for a folded
+    BN of ``channels`` — what serve-time engines rebuild ``batch_stats``
+    from (the artifact stores only the folded scale/bias)."""
+    import numpy as np
+
+    return {
+        "mean": np.zeros((channels,), np.float32),
+        "var": np.full((channels,), _bn_identity_var(eps), np.float32),
+    }
+
+
+def _is_bn_stats(node) -> bool:
+    return (
+        isinstance(node, dict)
+        and set(node.keys()) == {"mean", "var"}
+        and all(hasattr(v, "shape") for v in node.values())
+    )
+
+
+def fold_batch_norm(variables, eps: float = BN_EPS):
+    """Fold every eval-mode BatchNorm into per-channel scale/bias.
+
+    Eval BN computes ``(x - mean) * scale * rsqrt(var + eps) + bias``
+    with frozen running stats — two of the four per-channel vectors are
+    redundant at serve time. Returns new ``{params, batch_stats}`` where
+    each BN's params carry the folded affine
+
+        scale' = scale / sqrt(var + eps)
+        bias'  = bias - mean * scale'
+
+    and its running stats are the identity (:func:`bn_identity_stats`),
+    so the SAME ``model.apply(..., train=False)`` computes exactly
+    ``scale' * x + bias'`` — no model surgery, and the artifact needs to
+    ship half the BN state. Within fp32 rounding of the original eval
+    forward (pinned per arch in ``tests/test_serve.py``).
+
+    BN nodes are found structurally: any ``batch_stats`` subtree of
+    exactly ``{mean, var}`` arrays, whose ``params`` twin holds the
+    matching ``{scale, bias}``. Non-BN params pass through untouched.
+    """
+    import numpy as np
+
+    params = variables.get("params", {})
+    stats = variables.get("batch_stats", {}) or {}
+
+    def rec(p_node, s_node):
+        if _is_bn_stats(s_node):
+            mean = np.asarray(s_node["mean"], np.float32)
+            var = np.asarray(s_node["var"], np.float32)
+            scale = np.asarray(p_node["scale"], np.float32)
+            bias = np.asarray(p_node["bias"], np.float32)
+            mul = scale / np.sqrt(var + np.float32(eps))
+            new_p = dict(p_node)
+            new_p["scale"] = mul
+            new_p["bias"] = (bias - mean * mul).astype(np.float32)
+            return new_p, bn_identity_stats(len(mean), eps)
+        if not isinstance(s_node, dict):
+            return p_node, s_node
+        new_p = dict(p_node) if isinstance(p_node, dict) else p_node
+        new_s = {}
+        for k, sv in s_node.items():
+            sub_p = p_node.get(k) if isinstance(p_node, dict) else None
+            fp, fs = rec(sub_p, sv)
+            if isinstance(new_p, dict):
+                new_p[k] = fp
+            new_s[k] = fs
+        return new_p, new_s
+
+    folded_params, folded_stats = rec(params, stats)
+    return {"params": folded_params, "batch_stats": folded_stats}
+
+
+# ---------------------------------------------------------------------------
 # Param-tree utilities (conv ordering, weight access)
 # ---------------------------------------------------------------------------
 

@@ -6,14 +6,16 @@ that until now were compared by eyeball. This module turns "did this
 change regress the run?" into a machine-checkable verdict:
 
 - :func:`extract_run` normalizes any source — a telemetry run dir
-  (manifest + events + scalars), an ``ACCURACY_*``-shaped artifact, or
-  a ``BENCH_*``-shaped artifact — into one ``{provenance, metrics}``
+  (manifest + events + scalars; serve-bench run dirs included), an
+  ``ACCURACY_*``-shaped artifact, a ``BENCH_*``-shaped artifact, or a
+  serve-bench SLO ``verdict.json`` — into one ``{provenance, metrics}``
   record;
 - :func:`compare_runs` aligns candidates against a baseline on
-  manifest provenance (arch, dataset, recipe fields), then judges each
-  shared metric against a configurable tolerance: time-to-accuracy,
-  best/final top-1, jit step ms, img/s, MFU, HBM peak, wall time, and
-  run-ending alert counts;
+  manifest provenance (arch, dataset, recipe fields — serve sources
+  align on the recipe their export embedded), then judges each shared
+  metric against a configurable tolerance: time-to-accuracy, best/final
+  top-1, jit step ms, img/s, MFU, HBM peak, wall time, run-ending alert
+  counts, and the serving SLO (p99 latency, throughput, shed rate);
 - :func:`render_comparison` renders the human table; the verdict dict
   itself is strict JSON (``--json``) and deterministic — no clocks, no
   absolute paths beyond what the caller passed — so it can be diffed,
@@ -62,7 +64,31 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("mfu", "higher", "rel"),
     ("hbm_peak_bytes", "lower", "hbm"),
     ("alerts_critical", "lower", "count"),
+    # serving SLO metrics (serve-bench verdicts / serve run dirs):
+    # judged under --tol-rel like the other perf metrics; a shed-rate
+    # increase against a zero-shed baseline is always a regression
+    # (rel tolerance of 0 is 0)
+    ("serve_p99_ms", "lower", "rel"),
+    ("serve_throughput_rps", "higher", "rel"),
+    ("serve_shed_rate", "lower", "rel"),
 )
+
+# serve-verdict field -> compare metric name
+_SERVE_METRIC_FIELDS: Tuple[Tuple[str, str], ...] = (
+    ("p99_ms", "serve_p99_ms"),
+    ("throughput_rps", "serve_throughput_rps"),
+    ("shed_rate", "serve_shed_rate"),
+)
+
+# the metric-key skeleton every extracted source carries (None = the
+# source does not know this metric; _judge skips it). time_to_common_acc
+# is derived pairwise in compare_runs, never stored per source.
+_EMPTY_METRICS: Dict[str, Any] = {
+    name: None
+    for name, _, _ in METRIC_SPECS
+    if name != "time_to_common_acc_s"
+}
+_EMPTY_METRICS["alerts_total"] = None
 
 
 def _recipe_from_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
@@ -138,29 +164,42 @@ def _extract_run_dir(path: str) -> Dict[str, Any]:
             mfu = att.get("mfu")
 
     wm = hbm_watermark(memory)
+    metrics = dict(_EMPTY_METRICS)
+    metrics.update({
+        "best_acc1": best_acc1,
+        "final_acc1": final_acc1,
+        "time_to_target_s": (end or {}).get("time_to_target_s"),
+        "wall_s": (end or {}).get("wall_s"),
+        "img_per_s": img_per_s,
+        "jit_step_ms": jit_step_ms,
+        "mfu": mfu,
+        "hbm_peak_bytes": (wm or {}).get("peak_bytes"),
+        "alerts_total": len(alerts),
+        "alerts_critical": sum(
+            1 for a in alerts
+            if a.get("severity") == RUN_ENDING_SEVERITY
+        ),
+    })
+    # a serve-bench run dir: the final `serve` verdict event carries
+    # the SLO metrics; alignment uses the recipe the serve manifest
+    # copied from the export's provenance
+    from bdbnn_tpu.obs.events import serve_digest
+
+    serve_verdict = serve_digest(events)["verdict"]
+    if serve_verdict is not None:
+        for field, name in _SERVE_METRIC_FIELDS:
+            metrics[name] = serve_verdict.get(field)
     return {
         "source": path,
-        "format": "run_dir",
+        "format": (
+            "serve_run_dir" if serve_verdict is not None else "run_dir"
+        ),
         "provenance": {
             "config_hash": manifest.get("config_hash"),
             "device_kind": manifest.get("device_kind"),
             "recipe": _recipe_from_config(cfg),
         },
-        "metrics": {
-            "best_acc1": best_acc1,
-            "final_acc1": final_acc1,
-            "time_to_target_s": (end or {}).get("time_to_target_s"),
-            "wall_s": (end or {}).get("wall_s"),
-            "img_per_s": img_per_s,
-            "jit_step_ms": jit_step_ms,
-            "mfu": mfu,
-            "hbm_peak_bytes": (wm or {}).get("peak_bytes"),
-            "alerts_total": len(alerts),
-            "alerts_critical": sum(
-                1 for a in alerts
-                if a.get("severity") == RUN_ENDING_SEVERITY
-            ),
-        },
+        "metrics": metrics,
         "acc_curve": acc_curve,
     }
 
@@ -168,12 +207,36 @@ def _extract_run_dir(path: str) -> Dict[str, Any]:
 def _extract_artifact(path: str) -> Dict[str, Any]:
     with open(path) as f:
         d = json.load(f)
+    if "serve_verdict" in d:
+        # a serve-bench SLO verdict (serve/loadgen.py): aligned on the
+        # export provenance it embeds, judged on p99/throughput/shed
+        prov = d.get("provenance") or {}
+        metrics = dict(_EMPTY_METRICS)
+        for field, name in _SERVE_METRIC_FIELDS:
+            metrics[name] = d.get(field)
+        return {
+            "source": path,
+            "format": "serve_verdict",
+            "provenance": {
+                "config_hash": prov.get("config_hash"),
+                "device_kind": None,
+                "recipe": _recipe_from_config(prov.get("recipe") or {}),
+            },
+            "metrics": metrics,
+            "acc_curve": [],
+        }
     parsed = d.get("parsed")
     if isinstance(parsed, dict) and "metric" in parsed:
         # BENCH_*.json shape: a bench harness line under "parsed"
         recipe = _recipe_from_config(
             {"dtype": parsed.get("dtype")}
         )
+        metrics = dict(_EMPTY_METRICS)
+        metrics.update({
+            "img_per_s": parsed.get("value") or None,
+            "jit_step_ms": parsed.get("device_ms_per_step"),
+            "mfu": parsed.get("device_mfu"),
+        })
         return {
             "source": path,
             "format": "bench_artifact",
@@ -182,24 +245,20 @@ def _extract_artifact(path: str) -> Dict[str, Any]:
                 "device_kind": parsed.get("device_kind"),
                 "recipe": recipe,
             },
-            "metrics": {
-                "img_per_s": parsed.get("value") or None,
-                "jit_step_ms": parsed.get("device_ms_per_step"),
-                "mfu": parsed.get("device_mfu"),
-                "best_acc1": None,
-                "final_acc1": None,
-                "time_to_target_s": None,
-                "wall_s": None,
-                "hbm_peak_bytes": None,
-                "alerts_total": None,
-                "alerts_critical": None,
-            },
+            "metrics": metrics,
             "acc_curve": [],
         }
     if "best_val_top1" in d:
         # ACCURACY_*.json shape
         recipe = _recipe_from_config(d)
         curve = d.get("val_top1_curve") or []
+        metrics = dict(_EMPTY_METRICS)
+        metrics.update({
+            "best_acc1": d.get("best_val_top1"),
+            "final_acc1": curve[-1] if curve else None,
+            "time_to_target_s": d.get("time_to_target_s"),
+            "wall_s": d.get("wall_seconds"),
+        })
         return {
             "source": path,
             "format": "accuracy_artifact",
@@ -208,23 +267,13 @@ def _extract_artifact(path: str) -> Dict[str, Any]:
                 "device_kind": d.get("device_kind"),
                 "recipe": recipe,
             },
-            "metrics": {
-                "best_acc1": d.get("best_val_top1"),
-                "final_acc1": curve[-1] if curve else None,
-                "time_to_target_s": d.get("time_to_target_s"),
-                "wall_s": d.get("wall_seconds"),
-                "img_per_s": None,
-                "jit_step_ms": None,
-                "mfu": None,
-                "hbm_peak_bytes": None,
-                "alerts_total": None,
-                "alerts_critical": None,
-            },
+            "metrics": metrics,
             "acc_curve": [],
         }
     raise ValueError(
         f"{path!r}: not a recognized artifact (want a BENCH_*.json "
-        "'parsed' bench line or an ACCURACY_*.json with best_val_top1)"
+        "'parsed' bench line, an ACCURACY_*.json with best_val_top1, "
+        "or a serve-bench verdict.json)"
     )
 
 

@@ -43,7 +43,22 @@ Event kinds emitted by ``fit()``:
   gate without re-scanning every alert
 - ``run_end``     — best acc/epoch, total wall seconds
 
-``bench.py`` adds ``bench_result`` records with the same envelope.
+``bench.py`` adds ``bench_result`` records with the same envelope. The
+serving subsystem (``bdbnn_tpu/serve/``) adds two more:
+
+- ``export``      — a training checkpoint was frozen into a serving
+  artifact (serve/export.py): artifact path, arch, source checkpoint +
+  integrity verdict, binarized-conv count, compression ratio, and the
+  checkpoint's recorded eval top-1 the artifact claims to reproduce.
+  Appended to the SOURCE run's timeline, so the training→serving
+  hand-off is auditable from the run dir alone
+- ``serve``       — serving telemetry from ``serve-bench``
+  (serve/loadgen.py), disambiguated by ``phase``: ``start`` (buckets,
+  per-bucket AOT warmup seconds, load model), ``stats`` (live queue
+  depth, batch occupancy, rolling p99, shed/completed counts — what
+  ``watch`` renders for a serving run), ``verdict`` (the final SLO
+  verdict: p50/p95/p99 ms, throughput, shed rate, drain disposition —
+  what ``compare`` judges across builds)
 
 New kinds must be registered in :data:`KNOWN_KINDS` —
 ``tests/test_events_schema.py`` AST-scans every ``.emit(`` call site in
@@ -90,6 +105,8 @@ KNOWN_KINDS = frozenset(
         "health",
         "run_end",
         "bench_result",
+        "export",
+        "serve",
     }
 )
 
@@ -236,6 +253,27 @@ def read_events(
 load_events = read_events
 
 
+def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One shared digest of a timeline's serving telemetry — the
+    ``export`` events plus the ``serve`` phases (``start`` marker, the
+    ``stats`` trail, the LAST ``verdict``). ``summarize``, ``watch``
+    and ``compare`` all consume serving runs through this, so a
+    verdict-field change lands in one place instead of three."""
+    exports = [e for e in events if e.get("kind") == "export"]
+    serves = [e for e in events if e.get("kind") == "serve"]
+    return {
+        "exports": exports,
+        "start": next(
+            (e for e in serves if e.get("phase") == "start"), None
+        ),
+        "stats": [e for e in serves if e.get("phase") == "stats"],
+        "verdict": next(
+            (e for e in reversed(serves) if e.get("phase") == "verdict"),
+            None,
+        ),
+    }
+
+
 __all__ = [
     "EVENTS_NAME",
     "KNOWN_KINDS",
@@ -244,4 +282,5 @@ __all__ = [
     "load_events",
     "read_events",
     "read_jsonl",
+    "serve_digest",
 ]

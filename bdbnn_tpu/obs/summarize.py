@@ -276,6 +276,56 @@ def _health(events) -> Optional[Dict[str, Any]]:
     }
 
 
+def _serving(events) -> Optional[Dict[str, Any]]:
+    """The serving section: ``export`` hand-offs recorded on a training
+    run's timeline, and/or a ``serve-bench`` run's own start/stats/
+    verdict trail. None when the run has no serving telemetry."""
+    from bdbnn_tpu.obs.events import serve_digest
+
+    digest = serve_digest(events)
+    exports = digest["exports"]
+    start = digest["start"]
+    stats = digest["stats"]
+    verdict = digest["verdict"]
+    if not exports and start is None and not stats and verdict is None:
+        return None
+    return {
+        "exports": [
+            {
+                k: e.get(k)
+                for k in ("artifact", "arch", "checkpoint", "integrity",
+                          "binarized_convs", "compression_ratio",
+                          "checkpoint_acc1")
+            }
+            for e in exports
+        ],
+        "bench": (
+            {
+                k: start.get(k)
+                for k in ("artifact", "arch", "mode", "rate_rps",
+                          "requests", "buckets", "queue_depth",
+                          "max_delay_ms", "warmup_compile_s")
+            }
+            if start
+            else None
+        ),
+        "stats_events": len(stats),
+        "verdict": (
+            {
+                k: verdict.get(k)
+                for k in ("p50_ms", "p95_ms", "p99_ms", "throughput_rps",
+                          "mean_batch_occupancy", "shed_rate",
+                          "requests_submitted", "requests_completed",
+                          "requests_shed", "max_queue_depth_seen",
+                          "max_queue", "preempted", "drained_clean",
+                          "wall_s")
+            }
+            if verdict
+            else None
+        ),
+    }
+
+
 def _resilience(manifest, events) -> Dict[str, Any]:
     """Checkpoint/restart posture: how much work a preemption would
     cost right now, and how this run relates to its ancestors."""
@@ -365,6 +415,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     attribution = _attribution(run_dir, manifest, events)
     resilience = _resilience(manifest, events)
     health = _health(events)
+    serving = _serving(events)
 
     summary: Dict[str, Any] = {
         "run_dir": run_dir,
@@ -392,6 +443,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
         "attribution": attribution,
         "resilience": resilience,
         "health": health,
+        "serving": serving,
         "nonfinite_intervals": len(nonfinite),
     }
     # strict JSON out the other end too: a warn-policy run's NaN
@@ -449,6 +501,42 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                 )
         else:
             lines.append("health: monitored, no alerts")
+    if serving:
+        for ex in serving["exports"]:
+            lines.append(
+                f"export: {ex.get('artifact')} (arch {ex.get('arch')}, "
+                f"{ex.get('binarized_convs')} binary convs, "
+                f"{ex.get('compression_ratio')}x smaller, integrity "
+                f"{ex.get('integrity')}, recorded acc1 "
+                f"{ex.get('checkpoint_acc1')})"
+            )
+        bench = serving.get("bench")
+        if bench:
+            lines.append(
+                f"serving: {bench.get('mode')} load on {bench.get('arch')} "
+                f"| buckets {bench.get('buckets')} | queue bound "
+                f"{bench.get('queue_depth')} | coalesce "
+                f"{bench.get('max_delay_ms')}ms"
+            )
+        sv = serving.get("verdict")
+        if sv:
+            lines.append(
+                f"  SLO: p50 {sv.get('p50_ms')} / p95 {sv.get('p95_ms')} "
+                f"/ p99 {sv.get('p99_ms')} ms | "
+                f"{sv.get('throughput_rps')} req/s | occupancy "
+                f"{sv.get('mean_batch_occupancy')} | shed "
+                f"{sv.get('requests_shed')}/{sv.get('requests_submitted')}"
+                + (
+                    " | PREEMPTED, drained cleanly"
+                    if sv.get("preempted") and sv.get("drained_clean")
+                    else ""
+                )
+            )
+            if sv.get("max_queue_depth_seen") is not None:
+                lines.append(
+                    f"  queue: peak depth {sv.get('max_queue_depth_seen')}"
+                    f" of bound {sv.get('max_queue')}"
+                )
     if tta:
         lines.append("time-to-accuracy (val top-1):")
         for r in tta:

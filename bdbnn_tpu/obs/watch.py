@@ -29,6 +29,54 @@ def _mean(vals: List[float]) -> Optional[float]:
     return sum(vals) / len(vals) if vals else None
 
 
+def _serve_lines(events) -> List[str]:
+    """The serving view: when a timeline carries ``serve`` events (a
+    ``serve-bench`` run dir) render live queue depth, batch occupancy,
+    rolling p99 and shed count; ``export`` events on a TRAINING run's
+    timeline get a one-line hand-off note."""
+    from bdbnn_tpu.obs.events import serve_digest
+
+    digest = serve_digest(events)
+    lines: List[str] = []
+    for e in digest["exports"]:
+        lines.append(
+            f"export: {e.get('artifact')} (arch {e.get('arch')}, "
+            f"{e.get('binarized_convs')} binary convs, "
+            f"{e.get('compression_ratio')}x smaller, recorded acc1 "
+            f"{e.get('checkpoint_acc1')})"
+        )
+    start = digest["start"]
+    stats = digest["stats"]
+    verdict = digest["verdict"]
+    if start:
+        lines.append(
+            f"serve: {start.get('mode')} load on {start.get('arch')} | "
+            f"buckets {start.get('buckets')} | queue bound "
+            f"{start.get('queue_depth')} | {start.get('requests')} requests"
+        )
+    if stats and verdict is None:
+        s = stats[-1]
+        age = time.time() - float(s.get("t", time.time()))
+        occ = float(s.get("occupancy") or 0.0)
+        lines.append(
+            f"live:  queue {s.get('queue_depth')} | occupancy "
+            f"{occ:.0%} | rolling p99 {s.get('rolling_p99_ms')} ms | "
+            f"shed {s.get('shed')} | {s.get('completed')} done | "
+            f"{age:.0f}s ago"
+        )
+    if verdict:
+        shed_rate = float(verdict.get("shed_rate") or 0.0)
+        lines.append(
+            f"SLO:   p50 {verdict.get('p50_ms')} / p95 "
+            f"{verdict.get('p95_ms')} / p99 {verdict.get('p99_ms')} ms | "
+            f"{verdict.get('throughput_rps')} req/s | occupancy "
+            f"{verdict.get('mean_batch_occupancy')} | shed "
+            f"{shed_rate:.1%}"
+            + (" | PREEMPTED, drained" if verdict.get("preempted") else "")
+        )
+    return lines
+
+
 def render_status(
     events: List[Dict[str, Any]],
     manifest: Optional[Dict[str, Any]] = None,
@@ -50,6 +98,7 @@ def render_status(
     restarts = len((manifest or {}).get("restart_lineage") or [])
 
     lines = []
+    lines += _serve_lines(events)
     if start:
         lines.append(
             f"run: epochs {start.get('start_epoch', 0)}->"
@@ -165,7 +214,13 @@ def watch_run(
             last_size = size
             events = read_events(run_dir)
             out(render_status(events, manifest))
-            if once or any(e.get("kind") == "run_end" for e in events):
+            # a serve-bench run ends at its verdict, a training run at
+            # run_end — either terminates the tail
+            if once or any(
+                e.get("kind") == "run_end"
+                or (e.get("kind") == "serve" and e.get("phase") == "verdict")
+                for e in events
+            ):
                 return 0
             out("---")
         elif once:

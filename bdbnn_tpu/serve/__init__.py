@@ -1,0 +1,57 @@
+"""Serving subsystem: frozen-artifact export + batched inference.
+
+Training's other half. Four modules, composing bottom-up:
+
+- :mod:`bdbnn_tpu.serve.export`   — freeze a training checkpoint into a
+  deployment artifact (weights binarized once to packed sign +
+  per-channel alpha, BatchNorm folded to scale/bias, all training-only
+  state stripped, strict-JSON ``artifact.json`` provenance manifest)
+- :mod:`bdbnn_tpu.serve.engine`   — the inference runtime: eval-mode
+  forward AOT-compiled per batch-size bucket at startup, requests
+  padded up to the bucket (no first-request compile stall)
+- :mod:`bdbnn_tpu.serve.batching` — dynamic micro-batcher: bounded
+  request queue with deadline coalescing, explicit load shedding, and
+  latched-flag graceful drain (stdlib-only, engine injected)
+- :mod:`bdbnn_tpu.serve.loadgen`  — closed/open-loop (Poisson) load
+  generator producing the strict-JSON SLO verdict, plus the
+  ``serve-bench`` orchestration that wires everything to a run dir
+  (manifest + ``serve`` events) the obs/ tooling already understands
+
+CLI surface: ``export`` / ``predict`` / ``serve-bench``
+(``bdbnn_tpu.cli``). Import of this package root stays light — the
+modules lazy-import jax where they need it, so the batcher and verdict
+tooling work backend-free.
+"""
+
+from __future__ import annotations
+
+from bdbnn_tpu.serve.batching import LoadShedError, MicroBatcher
+from bdbnn_tpu.serve.export import (
+    ARTIFACT_NAME,
+    WEIGHTS_NAME,
+    export_artifact,
+    load_artifact_variables,
+    read_artifact,
+)
+from bdbnn_tpu.serve.loadgen import (
+    VERDICT_NAME,
+    LoadGenerator,
+    percentile,
+    run_serve_bench,
+    slo_verdict,
+)
+
+__all__ = [
+    "ARTIFACT_NAME",
+    "VERDICT_NAME",
+    "WEIGHTS_NAME",
+    "LoadGenerator",
+    "LoadShedError",
+    "MicroBatcher",
+    "export_artifact",
+    "load_artifact_variables",
+    "percentile",
+    "read_artifact",
+    "run_serve_bench",
+    "slo_verdict",
+]

@@ -1,0 +1,249 @@
+"""Dynamic micro-batching: coalesce requests, bound the queue, drain.
+
+Serving individual requests through a batched accelerator engine wants
+three properties the naive loop lacks:
+
+1. **Coalescing under a deadline** — single requests are batched up to
+   the engine's largest bucket, but never held past ``max_delay_ms``
+   from the first request's enqueue: throughput from batching, with a
+   hard cap on the latency it can add.
+2. **Bounded queue + load shedding** — the request queue has a fixed
+   capacity; when it is full, ``submit`` raises :class:`LoadShedError`
+   IMMEDIATELY (explicit rejection the client can retry against)
+   instead of growing without bound until the process dies far from the
+   overload that caused it.
+3. **Graceful drain** — ``drain()`` latches a flag (the same
+   latched-flag pattern as ``train/resilience.py``'s
+   ``PreemptionHandler``: the signal moment only sets state; the worker
+   loop observes it at a safe boundary), after which new submits are
+   shed but every request already accepted is answered before the
+   worker exits. SIGTERM → ``drain()`` is wired by the ``serve-bench``
+   CLI through a ``PreemptionHandler``.
+
+Stdlib-only: the engine is injected as a callable, so the batcher (and
+its tests) never need a JAX backend.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional
+
+
+class LoadShedError(RuntimeError):
+    """The request was rejected — queue full or batcher draining."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(f"request shed: {reason}")
+
+
+class _Request:
+    __slots__ = ("payload", "future", "t_enqueue")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalescing request batcher in front of a batch-callable engine.
+
+    ``runner(batch_list) -> results`` receives the payloads of one
+    coalesced batch and returns one result per payload (any indexable).
+    ``on_batch(stats_dict)`` (optional) fires after every executed
+    batch — the serve-bench CLI uses it to emit ``serve`` events.
+    """
+
+    def __init__(
+        self,
+        runner: Callable[[List[Any]], Any],
+        *,
+        max_batch: int = 32,
+        max_queue: int = 128,
+        max_delay_ms: float = 5.0,
+        on_batch: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ):
+        if max_batch <= 0 or max_queue <= 0:
+            raise ValueError("max_batch and max_queue must be positive")
+        self.runner = runner
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.on_batch = on_batch
+        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+        # latched drain flag (resilience.py pattern): set once, observed
+        # by the worker at batch boundaries and by submit immediately
+        self._draining = threading.Event()
+        self._lock = threading.Lock()
+        # set by the WORKER, under _lock, after its final queue sweep:
+        # once True no request can enter the queue, so no accepted
+        # Future can ever be left unresolved (see _worker/submit)
+        self._dead = False
+        self.shed = 0
+        self.completed = 0
+        self.batches = 0
+        self.occupancy_sum = 0.0
+        self.max_queue_depth_seen = 0
+        self._thread = threading.Thread(
+            target=self._worker, name="micro-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, payload) -> Future:
+        """Enqueue one request; returns its Future. Raises
+        :class:`LoadShedError` when draining or the queue is full —
+        never blocks the caller on a full queue.
+
+        The enqueue happens under ``_lock``, the same lock the worker's
+        drain-exit holds for its final queue sweep + ``_dead`` latch: a
+        request either lands before that sweep (and is answered or
+        explicitly failed by it) or observes ``_dead`` and is shed here
+        — an accepted Future can never be left unresolved."""
+        req = _Request(payload)
+        with self._lock:
+            if self._dead or self._draining.is_set():
+                self.shed += 1
+                raise LoadShedError("draining")
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self.shed += 1
+                raise LoadShedError("queue full") from None
+            self.max_queue_depth_seen = max(
+                self.max_queue_depth_seen, self._q.qsize()
+            )
+        return req.future
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Latch the drain flag, answer every accepted request, stop the
+        worker. Returns True when the worker exited within ``timeout``.
+        Idempotent.
+
+        The no-unresolved-Future guarantee is enforced by the worker's
+        exit protocol (final queue sweep + ``_dead`` latch under the
+        submit lock, see :meth:`_worker`), not by timing here."""
+        self._draining.set()
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "completed": self.completed,
+                "shed": self.shed,
+                "batches": self.batches,
+                "mean_occupancy": round(
+                    self.occupancy_sum / max(self.batches, 1), 4
+                ),
+                "queue_depth": self._q.qsize(),
+                "max_queue_depth_seen": self.max_queue_depth_seen,
+                "max_queue": self.max_queue,
+            }
+
+    # -- worker side ---------------------------------------------------
+
+    def _collect(self) -> List[_Request]:
+        """One coalesced batch: block for the first request (waking to
+        re-check the drain flag), then gather until the batch is full or
+        the first request's deadline passes."""
+        while True:
+            try:
+                first = self._q.get(timeout=0.02)
+                break
+            except queue.Empty:
+                if self._draining.is_set():
+                    return []
+        batch = [first]
+        deadline = first.t_enqueue + self.max_delay_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # deadline passed: take whatever is already queued, but
+                # wait no further
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+                continue
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _worker(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                # drain exit: latch _dead and sweep stragglers ATOMICALLY
+                # with respect to submit's enqueue — a request either
+                # landed before this sweep (failed here, explicitly) or
+                # its submit observes _dead and sheds. Futures are
+                # resolved outside the lock; nothing else touches them.
+                with self._lock:
+                    stragglers = []
+                    while True:
+                        try:
+                            stragglers.append(self._q.get_nowait())
+                        except queue.Empty:
+                            break
+                    self.shed += len(stragglers)
+                    self._dead = True
+                for req in stragglers:
+                    if not req.future.done():
+                        req.future.set_exception(LoadShedError("draining"))
+                return
+            t0 = time.monotonic()
+            try:
+                results = self.runner([r.payload for r in batch])
+            except Exception as e:
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                continue
+            t1 = time.monotonic()
+            for i, r in enumerate(batch):
+                # done() guard: a client may have cancel()ed its Future
+                # (set_result would raise InvalidStateError); a runner
+                # returning too few results must fail THAT future, not
+                # kill the worker thread for good
+                try:
+                    if not r.future.done():
+                        r.future.set_result(results[i])
+                except Exception as e:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            with self._lock:
+                self.completed += len(batch)
+                self.batches += 1
+                self.occupancy_sum += len(batch) / self.max_batch
+                stats = {
+                    "batch_size": len(batch),
+                    "occupancy": round(len(batch) / self.max_batch, 4),
+                    "queue_depth": self._q.qsize(),
+                    "run_ms": round((t1 - t0) * 1000.0, 3),
+                    "oldest_wait_ms": round(
+                        (t0 - batch[0].t_enqueue) * 1000.0, 3
+                    ),
+                    "completed": self.completed,
+                    "shed": self.shed,
+                }
+            if self.on_batch is not None:
+                try:
+                    self.on_batch(stats)
+                except Exception:
+                    pass  # telemetry must never kill the serving loop
+
+
+__all__ = ["LoadShedError", "MicroBatcher"]

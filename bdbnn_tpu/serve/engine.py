@@ -1,0 +1,164 @@
+"""Batched inference runtime over a frozen export artifact.
+
+The training stack compiles ONE train step and feeds it fixed-shape
+batches; serving inverts the problem — request batches arrive at
+arbitrary sizes, and XLA compiles per shape. The engine resolves that
+with **batch-size buckets**: a small ladder of batch sizes, each
+AOT-compiled at startup (``jax.jit(...).lower(...).compile()``), so no
+request ever pays a compile stall. A batch of n rows is padded up to
+the smallest bucket >= n (oversize batches are chunked through the
+largest bucket first); padding rows are sliced off before the caller
+sees logits.
+
+The model is the SAME flax module the run trained
+(``models.registry.create_model``) applied in eval mode — the artifact
+supplies reconstructed ``float_weight = sign * alpha`` tensors (exact
+fixed point of the training binarizer) and folded-BN identity stats, so
+serve logits match the training run's eval logits to fp32 rounding.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 8, 32)
+
+
+class InferenceEngine:
+    """Frozen-artifact inference with AOT-compiled batch buckets.
+
+    ``warmup()`` (called by ``__init__`` unless ``warm=False``) compiles
+    every bucket up front; ``predict_logits`` then never traces.
+    """
+
+    def __init__(
+        self,
+        artifact_dir: str,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        warm: bool = True,
+    ):
+        from bdbnn_tpu.models.registry import create_model
+        from bdbnn_tpu.serve.export import (
+            load_artifact_variables,
+            read_artifact,
+        )
+
+        if not buckets or any(int(b) <= 0 for b in buckets):
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        self.artifact_dir = artifact_dir
+        self.artifact = read_artifact(artifact_dir)
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        self.image_size = int(self.artifact["image_size"])
+        self.num_classes = int(self.artifact["num_classes"])
+        self.arch = self.artifact["arch"]
+        self.dataset = self.artifact["dataset"]
+
+        import jax
+
+        self._model = create_model(
+            self.arch,
+            self.dataset,
+            dtype=self.artifact.get("model", {}).get("dtype", "float32"),
+            twoblock=bool(
+                self.artifact.get("model", {}).get("twoblock", False)
+            ),
+        )
+        # weights go to device once; every compiled bucket closes over
+        # the same placed copies
+        self._variables = jax.device_put(
+            load_artifact_variables(artifact_dir)
+        )
+        self._compiled: Dict[int, Any] = {}
+        self.compile_seconds: Dict[int, float] = {}
+        if warm:
+            self.warmup()
+
+    # -- compilation ---------------------------------------------------
+
+    def _apply(self, variables, images):
+        return self._model.apply(variables, images, train=False)
+
+    def warmup(self) -> Dict[int, float]:
+        """AOT-compile every bucket; returns per-bucket compile seconds.
+        Idempotent — already-compiled buckets are skipped."""
+        import jax
+
+        for b in self.buckets:
+            if b in self._compiled:
+                continue
+            t0 = time.perf_counter()
+            zeros = jax.ShapeDtypeStruct(
+                (b, self.image_size, self.image_size, 3), np.float32
+            )
+            self._compiled[b] = (
+                jax.jit(self._apply).lower(self._variables, zeros).compile()
+            )
+            self.compile_seconds[b] = round(time.perf_counter() - t0, 3)
+        return dict(self.compile_seconds)
+
+    # -- inference -----------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def predict_logits(self, images: np.ndarray) -> np.ndarray:
+        """Logits for ``images`` (n, H, W, 3) float32, any n >= 1.
+        Pads up to the bucket (chunking through the largest bucket when
+        n exceeds it); callers only ever see the n real rows."""
+        images = np.asarray(images, np.float32)
+        if images.ndim == 3:
+            images = images[None]
+        n = len(images)
+        if n == 0:
+            return np.zeros((0, self.num_classes), np.float32)
+        big = self.buckets[-1]
+        if n > big:
+            return np.concatenate(
+                [
+                    self.predict_logits(images[i : i + big])
+                    for i in range(0, n, big)
+                ]
+            )
+        b = self._bucket_for(n)
+        if n < b:
+            pad = np.zeros((b - n, *images.shape[1:]), np.float32)
+            images = np.concatenate([images, pad])
+        logits = self._compiled[b](self._variables, images)
+        return np.asarray(logits)[:n]
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """Top-1 class indices for ``images``."""
+        return np.argmax(self.predict_logits(images), axis=-1)
+
+
+def evaluate_split(engine: InferenceEngine, pipe) -> Dict[str, Any]:
+    """Offline batch inference over a pipeline's split: top-1 over every
+    example, computed with the same ``100 * correct / count`` arithmetic
+    the training loop's ``_validate`` records — so an exported
+    checkpoint's accuracy can be checked for EXACT equality against the
+    run's recorded eval top-1."""
+    correct = 0
+    count = 0
+    batches = 0
+    for x, y in pipe.epoch(0):
+        pred = engine.predict(np.asarray(x))
+        correct += int(np.sum(pred == np.asarray(y)))
+        count += len(pred)
+        batches += 1
+    acc1 = 100.0 * correct / max(count, 1)
+    return {
+        "top1": acc1,
+        "correct": correct,
+        "count": count,
+        "batches": batches,
+    }
+
+
+__all__ = ["DEFAULT_BUCKETS", "InferenceEngine", "evaluate_split"]

@@ -1,0 +1,374 @@
+"""Freeze a training checkpoint into a deployment artifact.
+
+Training checkpoints drag the whole QAT apparatus along: latent float
+master weights, optimizer moments, the EDE (t, k) anneal, host RNG —
+none of which inference needs (XNOR-Net, arXiv:1603.05279, motivates
+binarization entirely by inference cost). ``export_artifact`` strips a
+checkpoint down to what the serve-time forward actually reads:
+
+- every binary conv's latent ``float_weight`` is binarized ONCE:
+  ``sign(W)`` bit-packed (1 bit/weight via ``np.packbits``) plus the
+  per-output-channel scale ``alpha = mean|W|`` in float32 — the exact
+  fixed point of the training-time binarizer, so reconstructing
+  ``sign * alpha`` and running the normal eval forward reproduces the
+  checkpoint's logits (``sign(sign·alpha) == sign``, ``mean|sign·alpha|
+  == alpha``);
+- every BatchNorm is folded into a per-channel scale/bias affine
+  (:func:`bdbnn_tpu.models.resnet.fold_batch_norm`) — running stats are
+  not shipped;
+- optimizer state, EDE schedule, resume cursors and host RNG are simply
+  never read (``load_export_payload`` returns weights only); the test
+  suite asserts no ``float_weight``/optimizer/EDE key survives into the
+  artifact;
+- a strict-JSON ``artifact.json`` manifest carries the model recipe and
+  run provenance (config, config hash, device kind, checkpoint
+  integrity verdict) copied from the run's ``manifest.json``, plus the
+  recorded eval top-1 the artifact claims to reproduce and a full
+  tensor index (path, kind, shape, dtype) for the ``weights.npz``
+  payload.
+
+The export is recorded as an ``export`` event in the source run's
+``events.jsonl``, so ``summarize``/``watch``/``compare`` see the
+training→serving hand-off on the same timeline as the run itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+ARTIFACT_NAME = "artifact.json"
+WEIGHTS_NAME = "weights.npz"
+ARTIFACT_SCHEMA_VERSION = 1
+
+# substrings that must never appear in an artifact's tensor index —
+# training-only state the export exists to strip (asserted by
+# tests/test_serve.py on a real exported artifact)
+FORBIDDEN_STATE = ("float_weight", "opt_state", "ede", "momentum", "rng")
+
+
+def _flat_leaves(tree, prefix=()) -> List[Tuple[Tuple[str, ...], Any]]:
+    out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree.keys()):
+            out += _flat_leaves(tree[k], prefix + (k,))
+    else:
+        out.append((prefix, tree))
+    return out
+
+
+def _file_sha256(path: str) -> str:
+    """Chunked sha256 of a file — the one hashing scheme both the
+    export (write) and load (verify) sides of the weights payload use."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _pack_sign(w: np.ndarray) -> np.ndarray:
+    """sign(w) with sign(0) := +1 (the binarizer's convention,
+    nn/binarize.py) packed to 1 bit/weight: bit 1 == +1."""
+    return np.packbits((w >= 0).reshape(-1))
+
+
+def unpack_sign(packed: np.ndarray, shape) -> np.ndarray:
+    """Inverse of :func:`_pack_sign`: ±1 float32 of ``shape``."""
+    n = int(np.prod(shape))
+    bits = np.unpackbits(packed)[:n].reshape(shape)
+    return (bits.astype(np.float32) * 2.0) - 1.0
+
+
+def _recipe_provenance(config: Dict[str, Any]) -> Dict[str, Any]:
+    from bdbnn_tpu.obs.compare import RECIPE_FIELDS
+
+    return {k: config.get(k) for k in RECIPE_FIELDS}
+
+
+def export_artifact(
+    source: str,
+    out_dir: str,
+    *,
+    arch: Optional[str] = None,
+    dataset: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Freeze ``source`` (a run dir or checkpoint dir) into ``out_dir``
+    (``artifact.json`` + ``weights.npz``); returns the artifact
+    manifest. ``arch``/``dataset`` override what the run manifest or
+    checkpoint payload recorded (needed when exporting a bare
+    checkpoint dir with no manifest)."""
+    from bdbnn_tpu.models.resnet import fold_batch_norm
+    from bdbnn_tpu.obs.events import EventWriter, jsonsafe, read_events
+    from bdbnn_tpu.obs.manifest import read_manifest
+    from bdbnn_tpu.utils.checkpoint import load_export_payload
+
+    payload = load_export_payload(source)
+
+    # provenance: the run manifest lives in the source dir or its parent
+    # (source may point at the checkpoint dir itself)
+    run_dir = None
+    manifest = None
+    for cand in (source, os.path.dirname(source.rstrip(os.sep))):
+        if cand and os.path.isdir(cand):
+            m = read_manifest(cand)
+            if m is not None:
+                manifest, run_dir = m, cand
+                break
+    config = (manifest or {}).get("config") or {}
+
+    arch = arch or config.get("arch") or payload["arch"]
+    dataset = dataset or config.get("dataset")
+    if not arch:
+        raise ValueError(
+            "checkpoint records no arch and none was passed; use --arch"
+        )
+    if not dataset:
+        # a silent default would bake the wrong num_classes/image_size
+        # into the artifact and serve garbage without an error
+        raise ValueError(
+            "checkpoint records no dataset (bare checkpoint dir with no "
+            "run manifest) and none was passed; use --dataset"
+        )
+    num_classes = {"cifar10": 10, "cifar100": 100, "imagenet": 1000}[dataset]
+    image_size = 224 if dataset == "imagenet" else 32
+
+    # host numpy trees (orbax restores numpy on the local path already;
+    # normalize defensively so the fold/pack math never traces)
+    to_np = lambda t: {
+        k: to_np(v) if isinstance(v, dict) else np.asarray(v)
+        for k, v in t.items()
+    }
+    variables = fold_batch_norm(
+        {
+            "params": to_np(payload["params"]),
+            "batch_stats": to_np(payload["batch_stats"]),
+        }
+    )
+
+    tensors: List[Dict[str, Any]] = []
+    arrays: Dict[str, np.ndarray] = {}
+    bn_paths: List[str] = []
+    dense_bytes = 0
+    packed_bytes = 0
+    binarized = 0
+
+    for path, leaf in _flat_leaves(variables["params"]):
+        name = "/".join(path)
+        leaf = np.asarray(leaf)
+        if path[-1] == "float_weight" and leaf.ndim == 4:
+            # binarize ONCE: packed sign + per-out-channel alpha
+            alpha = np.mean(
+                np.abs(leaf.astype(np.float32)),
+                axis=tuple(range(leaf.ndim - 1)),
+            ).astype(np.float32)
+            packed = _pack_sign(leaf)
+            base = "/".join(path[:-1])
+            arrays[f"sign:{base}"] = packed
+            arrays[f"alpha:{base}"] = alpha
+            tensors.append({
+                "path": base,
+                "kind": "binary",
+                "shape": list(leaf.shape),
+                "dtype": "1bit+f32alpha",
+            })
+            binarized += 1
+            dense_bytes += leaf.astype(np.float32).nbytes
+            packed_bytes += packed.nbytes + alpha.nbytes
+        else:
+            arr = leaf.astype(np.float32) if leaf.dtype != np.float32 else leaf
+            arrays[f"dense:{name}"] = arr
+            tensors.append({
+                "path": name,
+                "kind": "dense",
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            })
+            dense_bytes += arr.nbytes
+            packed_bytes += arr.nbytes
+    # folded BN stats are NOT shipped — only their module paths, so the
+    # engine can rebuild the identity stats (bn_identity_stats)
+    for path, leaf in _flat_leaves(variables["batch_stats"]):
+        if path[-1] == "mean":
+            bn_paths.append("/".join(path[:-1]))
+
+    for t in tensors:
+        low = t["path"].lower()
+        if any(f in low for f in FORBIDDEN_STATE):
+            raise AssertionError(
+                f"training-only state leaked into the artifact: {t['path']}"
+            )
+
+    # the eval accuracy this artifact claims to reproduce: ONLY a
+    # model_best payload's best_acc1 is the exported weights' own
+    # recorded top-1. A rolling-checkpoint export (run preempted before
+    # any model_best landed, or a bare checkpoint dir) carries weights
+    # whose accuracy was never evaluated — claiming best-so-far there
+    # would make `predict --check` judge the weights against a number
+    # they never produced, so checkpoint_acc1 stays None and the
+    # best-seen value is recorded separately for context.
+    from bdbnn_tpu.utils.checkpoint import BEST_NAME
+
+    src_base = os.path.basename(payload["source"].rstrip(os.sep))
+    from_best = src_base.startswith(BEST_NAME)
+    eval_events = read_events(run_dir, "eval") if run_dir else []
+    recorded = {
+        "source": "model_best" if from_best else "checkpoint",
+        "checkpoint_acc1": payload["best_acc1"] if from_best else None,
+        "best_seen_acc1": payload["best_acc1"],
+        "checkpoint_epoch": payload["epoch"],
+        "final_eval_acc1": (
+            eval_events[-1].get("acc1") if eval_events else None
+        ),
+        "evals_recorded": len(eval_events),
+    }
+
+    artifact = {
+        "schema": ARTIFACT_SCHEMA_VERSION,
+        "created_unix": round(time.time(), 3),
+        "arch": arch,
+        "dataset": dataset,
+        "num_classes": num_classes,
+        "image_size": image_size,
+        "model": {
+            "dtype": config.get("dtype", "float32"),
+            "twoblock": bool(config.get("twoblock", False)),
+        },
+        "eval": recorded,
+        "checkpoint": {
+            "source": payload["source"],
+            "integrity": payload["integrity"],
+            "fallback": payload["fallback"],
+        },
+        "provenance": {
+            "run_dir": os.path.abspath(run_dir) if run_dir else None,
+            "config_hash": (manifest or {}).get("config_hash"),
+            "device_kind": (manifest or {}).get("device_kind"),
+            "recipe": _recipe_provenance(config),
+            "config": config,
+        },
+        "tensors": tensors,
+        "bn_folded": sorted(bn_paths),
+        "stats": {
+            "binarized_convs": binarized,
+            "dense_bytes": dense_bytes,
+            "artifact_bytes": packed_bytes,
+            "compression_ratio": round(
+                dense_bytes / max(packed_bytes, 1), 3
+            ),
+        },
+    }
+
+    os.makedirs(out_dir, exist_ok=True)
+    # atomic pair: weights land via tmp+rename, and artifact.json
+    # records their sha256 — load_artifact_variables verifies it, so a
+    # crash between the two renames (new weights, stale manifest — or
+    # the reverse) reads as a loud digest mismatch, never as a silently
+    # wrong artifact
+    wtmp = os.path.join(out_dir, WEIGHTS_NAME + ".tmp")
+    with open(wtmp, "wb") as f:
+        np.savez(f, **arrays)
+    artifact["weights_sha256"] = _file_sha256(wtmp)
+    os.replace(wtmp, os.path.join(out_dir, WEIGHTS_NAME))
+    tmp = os.path.join(out_dir, ARTIFACT_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(jsonsafe(artifact), f, indent=2, sort_keys=True)
+    os.replace(tmp, os.path.join(out_dir, ARTIFACT_NAME))
+
+    if run_dir is not None:
+        # the export lands on the run's own timeline
+        ev = EventWriter(run_dir)
+        ev.emit(
+            "export",
+            artifact=os.path.abspath(out_dir),
+            arch=arch,
+            dataset=dataset,
+            checkpoint=payload["source"],
+            integrity=payload["integrity"],
+            binarized_convs=binarized,
+            compression_ratio=artifact["stats"]["compression_ratio"],
+            checkpoint_acc1=recorded["checkpoint_acc1"],
+        )
+        ev.close()
+    return artifact
+
+
+def read_artifact(artifact_dir: str) -> Dict[str, Any]:
+    """Load ``artifact.json``; raises with a pointed message when the
+    dir is not an export artifact."""
+    path = os.path.join(artifact_dir, ARTIFACT_NAME)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{artifact_dir!r} holds no {ARTIFACT_NAME} — not an export "
+            "artifact (run `python -m bdbnn_tpu.cli export` first)"
+        )
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_artifact_variables(artifact_dir: str) -> Dict[str, Any]:
+    """Rebuild the eval-apply ``{params, batch_stats}`` trees from an
+    artifact: binary convs get ``float_weight = sign * alpha`` (the
+    exact fixed point of the training binarizer — re-binarizing it
+    yields the same sign and the same per-channel alpha), folded BNs get
+    identity running stats.
+
+    The weights payload is verified against the manifest's recorded
+    sha256 first: a torn re-export (new weights under a stale manifest,
+    or vice versa) fails loudly here instead of serving the wrong
+    checkpoint."""
+    from bdbnn_tpu.models.resnet import bn_identity_stats
+
+    artifact = read_artifact(artifact_dir)
+    wpath = os.path.join(artifact_dir, WEIGHTS_NAME)
+    want = artifact.get("weights_sha256")
+    if want:
+        if _file_sha256(wpath) != want:
+            raise RuntimeError(
+                f"{wpath} does not match the sha256 recorded in "
+                f"{ARTIFACT_NAME} — torn or mixed re-export; re-run "
+                "`export` into a fresh directory"
+            )
+    z = np.load(wpath)
+
+    def set_path(tree, path, leaf):
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = leaf
+
+    params: Dict[str, Any] = {}
+    for t in artifact["tensors"]:
+        path = tuple(t["path"].split("/"))
+        if t["kind"] == "binary":
+            sign = unpack_sign(z[f"sign:{t['path']}"], t["shape"])
+            alpha = z[f"alpha:{t['path']}"]
+            set_path(params, path + ("float_weight",), sign * alpha)
+        else:
+            set_path(params, path, z[f"dense:{t['path']}"])
+
+    batch_stats: Dict[str, Any] = {}
+    for bn in artifact["bn_folded"]:
+        path = tuple(bn.split("/"))
+        node = params
+        for k in path:
+            node = node[k]
+        set_path(batch_stats, path, bn_identity_stats(len(node["scale"])))
+    return {"params": params, "batch_stats": batch_stats}
+
+
+__all__ = [
+    "ARTIFACT_NAME",
+    "ARTIFACT_SCHEMA_VERSION",
+    "FORBIDDEN_STATE",
+    "WEIGHTS_NAME",
+    "export_artifact",
+    "load_artifact_variables",
+    "read_artifact",
+    "unpack_sign",
+]

@@ -120,20 +120,22 @@ def select_hooked_paths(params, cfg: RunConfig):
     return tuple(by_name[n] for n in selected)
 
 
-def build_datasets(cfg: RunConfig):
+def build_datasets(cfg: RunConfig, *, val_only: bool = False):
     """Dataset + pipelines per config (↔ reference ``loader.py`` +
     ``train.py:370-379``). A missing data directory is a HARD ERROR
     unless ``--synthetic`` was passed — a typo'd path must never turn
-    into a plausible-looking run on random tensors."""
+    into a plausible-looking run on random tensors.
+
+    ``val_only`` (serving's offline ``predict``) skips loading the
+    train split entirely and returns ``(None, val_pipe, image_size)`` —
+    an inference pass must not pay the train split's I/O or worker
+    pools."""
     host_id = jax.process_index()
     num_hosts = jax.process_count()
     per_host_batch = cfg.batch_size // num_hosts
     image_size = 224 if cfg.dataset == "imagenet" else 32
 
     if cfg.synthetic:
-        train_ds = synthetic_dataset(
-            cfg.synthetic_train_size, image_size, cfg.num_classes, seed=1
-        )
         val_ds = synthetic_dataset(
             cfg.synthetic_val_size, image_size, cfg.num_classes, seed=2
         )
@@ -146,12 +148,17 @@ def build_datasets(cfg: RunConfig):
             ds, per_host_batch, train=train, transform=transform,
             seed=cfg.seed or 0, host_id=host_id, num_hosts=num_hosts,
         )
+        if val_only:
+            return None, mk(val_ds, False), image_size
+        train_ds = synthetic_dataset(
+            cfg.synthetic_train_size, image_size, cfg.num_classes, seed=1
+        )
         return mk(train_ds, True), mk(val_ds, False), image_size
 
     if cfg.dataset in ("cifar10", "cifar100"):
         loader = load_cifar10 if cfg.dataset == "cifar10" else load_cifar100
         try:
-            train_ds = loader(cfg.data, "train")
+            train_ds = None if val_only else loader(cfg.data, "train")
             val_ds = loader(cfg.data, "test")
         except (FileNotFoundError, OSError) as e:
             raise FileNotFoundError(
@@ -167,6 +174,8 @@ def build_datasets(cfg: RunConfig):
             num_hosts=num_hosts,
             device_normalize=cfg.device_normalize,
         )
+        if val_only:
+            return None, mk(val_ds, False), image_size
         return mk(train_ds, True), mk(val_ds, False), image_size
 
     try:
@@ -227,7 +236,7 @@ def build_datasets(cfg: RunConfig):
                 **extra,
             )
 
-        train_pipe = mk_folder("train", True)
+        train_pipe = None if val_only else mk_folder("train", True)
         val_pipe = mk_folder("val", False)
     except (FileNotFoundError, OSError) as e:
         raise FileNotFoundError(

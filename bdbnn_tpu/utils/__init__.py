@@ -1,6 +1,7 @@
 from bdbnn_tpu.utils import checkpoint, logging_utils, meters
 from bdbnn_tpu.utils.checkpoint import (
     load_checkpoint,
+    load_export_payload,
     load_variables,
     save_checkpoint,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "logging_utils",
     "meters",
     "load_checkpoint",
+    "load_export_payload",
     "load_variables",
     "save_checkpoint",
     "ScalarWriter",

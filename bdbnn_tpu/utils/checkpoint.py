@@ -373,6 +373,60 @@ def load_variables(path: str) -> Dict[str, Any]:
     }
 
 
+def load_export_payload(path: str) -> Dict[str, Any]:
+    """Read-side restore for the serving exporter (serve/export.py):
+    weights + checkpoint metadata + integrity provenance, no template.
+
+    ``path`` may be a run dir (``model_best`` preferred — its payload's
+    ``best_acc1`` IS that checkpoint's own eval accuracy, which is what
+    a frozen artifact should claim to reproduce) or a specific
+    checkpoint dir. Candidates are tried in :func:`_candidate_dirs`
+    order with the same integrity-verdict-then-fallback protocol as
+    :func:`load_checkpoint`, so exporting from a torn run dir picks the
+    surviving checkpoint instead of crashing. Returns ``{params,
+    batch_stats, arch, epoch, best_acc1, source, integrity, fallback,
+    resume_state}`` with host (numpy) arrays.
+    """
+    best = os.path.join(path, BEST_NAME)
+    if os.path.isdir(best) or os.path.isdir(best + ".old"):
+        path = best
+    candidates = _candidate_dirs(path)
+    failures: List[str] = []
+    for cand in candidates:
+        integrity = verify_integrity(cand)
+        if integrity == "mismatch":
+            failures.append(f"{cand}: integrity digest mismatch")
+            continue
+        try:
+            payload = _checkpointer().restore(cand)
+        except Exception as e:  # orbax raises various types on torn dirs
+            failures.append(f"{cand}: {type(e).__name__}: {e}")
+            continue
+        state = (
+            payload.get("state", payload)
+            if isinstance(payload, dict)
+            else payload
+        )
+        if not isinstance(state, dict) or "params" not in state:
+            failures.append(f"{cand}: no state/params in payload")
+            continue
+        return {
+            "params": state["params"],
+            "batch_stats": state.get("batch_stats", {}) or {},
+            "arch": payload.get("arch", ""),
+            "epoch": int(payload.get("epoch", 0)),
+            "best_acc1": float(payload.get("best_acc1", 0.0)),
+            "source": cand,
+            "integrity": integrity,
+            "fallback": cand != candidates[0],
+            "resume_state": read_resume_state(cand),
+        }
+    raise RuntimeError(
+        f"no exportable checkpoint under {path!r}; tried:\n  "
+        + "\n  ".join(failures or ["(no candidate dirs)"])
+    )
+
+
 def _candidate_dirs(path: str) -> List[str]:
     """Restore candidates in preference order: the committed checkpoint
     first, then ``.old`` (survivor of a mid-commit crash, or the

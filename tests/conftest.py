@@ -42,6 +42,7 @@ _NOT_FAST_CLASSES = {
     "TestWatchSubcommand",
     "TestSummarizeStrict",
     "TestCompareSubcommand",
+    "TestServeCliSmoke",
 }
 
 
@@ -249,3 +250,33 @@ def fixture_run_dir(tmp_path):
     and a full event timeline whose phase timing reads input-bound
     (data-wait share 0.5)."""
     return _write_fixture_run_dir(str(tmp_path / "run"))
+
+
+@pytest.fixture(scope="session")
+def tiny_trained_run_dir(tmp_path_factory):
+    """A REAL (smoke-scale) training run dir, produced once per session
+    by an in-process fit() on resnet8_tiny + synthetic CIFAR: manifest,
+    events (incl. eval accuracies), scalars, and a committed checkpoint
+    + model_best. The serving tests export from it and check the
+    artifact reproduces its recorded eval top-1; the CLI smoke drives
+    export -> predict over it as real subprocesses."""
+    from bdbnn_tpu.configs.config import RunConfig
+    from bdbnn_tpu.obs.summarize import resolve_run_dir
+    from bdbnn_tpu.train.loop import fit
+
+    root = tmp_path_factory.mktemp("tiny_train")
+    cfg = RunConfig(
+        dataset="cifar10",
+        arch="resnet8_tiny",
+        synthetic=True,
+        synthetic_train_size=64,
+        synthetic_val_size=64,
+        batch_size=16,
+        epochs=1,
+        lr=0.05,
+        print_freq=2,
+        log_path=str(root),
+        seed=0,
+    )
+    fit(cfg)
+    return resolve_run_dir(str(root))

@@ -328,6 +328,50 @@ class TestCompareSubcommand:
         assert proc.returncode == 2  # argparse usage error
 
 
+class TestServeCliSmoke:
+    """The full artifact round trip as real subprocesses: ``export`` a
+    session-trained resnet8_tiny run, then ``predict --check`` the
+    artifact over the run's own synthetic val split — exit 3 unless the
+    reported top-1 EXACTLY matches the exported checkpoint's recorded
+    eval accuracy. This is the tier-1 smoke for the serving acceptance
+    criterion."""
+
+    def test_export_then_predict_reproduces_recorded_top1(
+        self, tiny_trained_run_dir, tmp_path
+    ):
+        art = str(tmp_path / "artifact")
+        # one subprocess driving both subcommands through the real CLI
+        # entrypoint (sharing the jax import keeps the smoke inside the
+        # tier-1 budget); predict --check itself enforces the exact
+        # top-1 reproduction with exit 3 on mismatch
+        driver = (
+            "import json, sys\n"
+            "from bdbnn_tpu.cli import main\n"
+            f"rc = main(['export', {tiny_trained_run_dir!r}, '-o', {art!r}])\n"
+            "assert rc == 0, rc\n"
+            f"rc = main(['predict', {art!r}, '--check'])\n"
+            "sys.exit(rc)\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", driver],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, (
+            proc.stdout[-800:] + proc.stderr[-800:]
+        )
+        exported = json.loads(
+            proc.stdout[: proc.stdout.index("}") + 1]
+        )
+        assert exported["binarized_convs"] == 5
+        assert exported["compression_ratio"] > 1.0
+        assert exported["integrity"] == "ok"
+        result = json.loads(proc.stdout[proc.stdout.index("}") + 1:])
+        assert result["match"] is True
+        assert result["top1"] == exported["checkpoint_acc1"]
+        assert result["count"] == 64
+
 class TestWatchSubcommand:
     """``python -m bdbnn_tpu.cli watch RUN_DIR --once`` — the live-tail
     status view, as a real subprocess against the fixture run dir. Like

@@ -87,12 +87,13 @@ class TestEmitCallSites:
         )
         # the scan actually saw the package's core kinds (guards
         # against the AST walk silently matching nothing) — including
-        # the four resilience kinds and the two health-monitor kinds,
+        # the four resilience kinds, the two health-monitor kinds, and
+        # the two serving kinds (serve/export.py, serve/loadgen.py),
         # which must keep real call sites
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
-                "alert", "health"} <= found
+                "alert", "health", "export", "serve"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync."""
@@ -163,6 +164,49 @@ class TestStrictRfc8259:
         assert jsonsafe(True) is True
         assert jsonsafe(0) == 0 and jsonsafe(0) is not False
         assert jsonsafe("NaN") == "NaN"  # strings pass through
+
+    def test_serve_kind_payloads_roundtrip(self, tmp_path):
+        """The real export/serve payload shapes (serve/export.py,
+        serve/loadgen.py) with adversarial values in the numeric slots:
+        a NaN latency percentile must land as null, numpy counters must
+        unwrap, and the nested warmup/bucket structures must survive."""
+        ev = EventWriter(str(tmp_path))
+        x = ev.emit(
+            "export",
+            artifact="/tmp/art",
+            arch="resnet8_tiny",
+            checkpoint="/tmp/run/model_best",
+            integrity="ok",
+            binarized_convs=np.int64(5),
+            compression_ratio=np.float32(7.1),
+            checkpoint_acc1=float("nan"),
+        )
+        s = ev.emit(
+            "serve",
+            phase="verdict",
+            p50_ms=np.float32(4.25),
+            p99_ms=float("inf"),
+            throughput_rps=np.float64(450.5),
+            shed_rate=0.0,
+            mean_batch_occupancy=np.float32("nan"),
+            warmup_compile_s={"1": np.float32(0.5), "8": 1.25},
+            buckets=[np.int64(1), 8],
+            preempted=np.bool_(False),
+            drained_clean=True,
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "export"
+        assert lines[0]["binarized_convs"] == 5
+        assert isinstance(lines[0]["binarized_convs"], int)
+        assert lines[0]["checkpoint_acc1"] is None  # NaN -> null
+        assert lines[1]["kind"] == "serve"
+        assert lines[1]["p99_ms"] is None  # Inf -> null, never a token
+        assert lines[1]["warmup_compile_s"]["1"] == 0.5
+        assert lines[1]["buckets"] == [1, 8]
+        assert lines[1]["preempted"] is False
+        assert x["checkpoint_acc1"] is None and s["p50_ms"] == 4.25
 
     def test_health_kind_payloads_roundtrip(self, tmp_path):
         """The real alert/health payload shapes the monitor emits
