@@ -124,7 +124,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--save-every-mins", type=float, default=0.0,
-        help="mid-epoch checkpoint every M wallclock minutes (0 = off)",
+        help="mid-epoch checkpoint every M wallclock minutes (0 = off; "
+        "pod-safe: process 0's clock decides and the decision rides "
+        "the step-boundary coordination all-reduce)",
     )
     p.add_argument(
         "--pretrained-path", default="", type=str,

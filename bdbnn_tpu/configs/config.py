@@ -68,10 +68,12 @@ class RunConfig:
     resume: str = ""
     reset_resume: bool = False
     # mid-epoch checkpoint cadence (train/resilience.py): save every N
-    # completed steps (deterministic across hosts — pod-safe) and/or
-    # every M wallclock minutes (per-host clock). 0 = epoch-end saves
-    # only. Either way SIGTERM/SIGINT always triggers a final mid-epoch
-    # checkpoint before exiting with the preempt code (75).
+    # completed steps (deterministic across hosts) and/or every M
+    # wallclock minutes (process 0's clock, broadcast to the pod by the
+    # step-boundary coordination all-reduce — both cadences are
+    # pod-safe). 0 = epoch-end saves only. Either way SIGTERM/SIGINT
+    # always triggers a final coordinated mid-epoch checkpoint before
+    # every host exits with the preempt code (75).
     save_every_steps: int = 0
     save_every_mins: float = 0.0
     evaluate: bool = False

@@ -48,19 +48,29 @@ from bdbnn_tpu.data.datasets import (
 # ---------------------------------------------------------------------------
 
 
-def random_crop_pad(
-    images: np.ndarray, rng: np.random.Generator, pad: int = 4
+def _pad_crop(
+    images: np.ndarray, ys: np.ndarray, xs: np.ndarray, pad: int
 ) -> np.ndarray:
-    """torchvision RandomCrop(H, padding=pad): zero-pad then random crop."""
+    """Zero-pad then crop each sample at its (ys, xs) offset — the
+    shared mechanics under both draw sources (sequential Generator and
+    per-sample keys)."""
     n, h, w, c = images.shape
     padded = np.zeros((n, h + 2 * pad, w + 2 * pad, c), images.dtype)
     padded[:, pad : pad + h, pad : pad + w] = images
-    ys = rng.integers(0, 2 * pad + 1, size=n)
-    xs = rng.integers(0, 2 * pad + 1, size=n)
     out = np.empty_like(images)
     for i in range(n):
         out[i] = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
     return out
+
+
+def random_crop_pad(
+    images: np.ndarray, rng: np.random.Generator, pad: int = 4
+) -> np.ndarray:
+    """torchvision RandomCrop(H, padding=pad): zero-pad then random crop."""
+    n = len(images)
+    ys = rng.integers(0, 2 * pad + 1, size=n)
+    xs = rng.integers(0, 2 * pad + 1, size=n)
+    return _pad_crop(images, ys, xs, pad)
 
 
 def random_hflip(images: np.ndarray, rng: np.random.Generator) -> np.ndarray:
@@ -80,6 +90,76 @@ def cifar_train_augment(images: np.ndarray, rng: np.random.Generator) -> np.ndar
     x = random_crop_pad(images, rng, pad=4)
     x = random_hflip(x, rng)
     return normalize(x, CIFAR_MEAN, CIFAR_STD)
+
+
+# ---------------------------------------------------------------------------
+# Per-sample augment keys (topology-invariant)
+# ---------------------------------------------------------------------------
+
+# splitmix64 finalizer — the same mixing discipline _stateless_seeds
+# uses for the tf.data backend, shared here so every pipeline keys its
+# augment randomness by (seed, epoch, GLOBAL sample index) and the
+# stream is invariant to host count / batch assignment: an elastic
+# resume onto a different topology feeds bit-identical augmented
+# samples (docs/design.md §7).
+
+
+def _splitmix64(z: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):  # wraps mod 2^64 by design
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def sample_augment_keys(
+    seed: int, epoch: int, sample_indices: np.ndarray
+) -> np.ndarray:
+    """[n] uint64 per-sample augment keys mixed from (pipeline seed,
+    epoch, global dataset index). Keying by the GLOBAL index — never by
+    host id or position in the host's stream — is what makes the
+    augmented batch stream a pure function of the dataset permutation:
+    any (host_id, num_hosts) sharding of the same permutation sees the
+    same augmented pixels for the same sample."""
+    with np.errstate(over="ignore"):
+        z = (
+            np.asarray(sample_indices).astype(np.uint64)
+            + np.uint64(seed & 0xFFFFFFFF) * np.uint64(0x9E3779B97F4A7C15)
+            + np.uint64(epoch) * np.uint64(0xD1342543DE82EF95)
+        )
+    return _splitmix64(z)
+
+
+def keyed_crop_flip(
+    images: np.ndarray, keys: np.ndarray, pad: int = 4
+) -> np.ndarray:
+    """RandomCrop(H, padding=pad) + HFlip with per-sample draws derived
+    from ``keys`` (one uint64 per sample) instead of a shared
+    sequential Generator — same augment semantics as
+    :func:`random_crop_pad` + :func:`random_hflip`, but the draw for a
+    sample depends only on its key."""
+    with np.errstate(over="ignore"):
+        span = np.uint64(2 * pad + 1)
+        ys = (_splitmix64(keys ^ np.uint64(0xA5A5A5A5A5A5A5A5)) % span).astype(np.int64)
+        xs = (_splitmix64(keys ^ np.uint64(0xC3C3C3C3C3C3C3C3)) % span).astype(np.int64)
+        flips = (
+            _splitmix64(keys ^ np.uint64(0x0F0F0F0F0F0F0F0F)) & np.uint64(1)
+        ).astype(bool)
+    out = _pad_crop(images, ys, xs, pad)
+    out[flips] = out[flips, :, ::-1]
+    return out
+
+
+def cifar_train_augment_keyed(
+    images: np.ndarray, keys: np.ndarray
+) -> np.ndarray:
+    return normalize(keyed_crop_flip(images, keys), CIFAR_MEAN, CIFAR_STD)
+
+
+def cifar_train_augment_u8_keyed(
+    images: np.ndarray, keys: np.ndarray
+) -> np.ndarray:
+    """Geometric augment only, staying uint8 (device-normalize path)."""
+    return keyed_crop_flip(images, keys)
 
 
 def cifar_eval_transform(images: np.ndarray) -> np.ndarray:
@@ -189,20 +269,25 @@ class Pipeline:
         self.ds = dataset
         self.batch_size = batch_size
         self.train = train
+        # default TRAIN transforms use per-sample keys (global-index
+        # derived — topology-invariant, see sample_augment_keys); a
+        # custom ``transform(images, rng)`` keeps the legacy per-batch
+        # Generator contract (rng keyed by host/batch — NOT invariant
+        # to host count; document if you rely on elastic resume)
+        self._keyed = None
         if transform is None:
-            if device_normalize:
+            if train:
+                self._keyed = (
+                    cifar_train_augment_u8_keyed
+                    if device_normalize
+                    else cifar_train_augment_keyed
+                )
+                transform = None
+            elif device_normalize:
                 # uint8 out; the jitted step normalizes on device
-                transform = (
-                    cifar_train_augment_u8
-                    if train
-                    else lambda images, rng: images
-                )
+                transform = lambda images, rng: images
             else:
-                transform = (
-                    cifar_train_augment
-                    if train
-                    else lambda images, rng: cifar_eval_transform(images)
-                )
+                transform = lambda images, rng: cifar_eval_transform(images)
         self.transform = transform
         self.seed = seed
         self.host_id = host_id
@@ -239,14 +324,23 @@ class Pipeline:
             num_hosts=self.num_hosts,
             drop_remainder_to=self.batch_size if self.train else None,
         )
-        # augment RNG is derived PER BATCH from (seed, epoch, host, batch
-        # index) — not one sequential stream — so a resumed epoch
-        # (start_step > 0) skips straight to batch k without replaying
-        # the augmentation draws of batches it never yields, and the
-        # resumed tail is bit-identical to an uninterrupted epoch's
+        # default augment draws are keyed PER SAMPLE by (seed, epoch,
+        # global dataset index) — not one sequential stream — so a
+        # resumed epoch (start_step > 0) skips straight to batch k
+        # without replaying draws for batches it never yields, the
+        # resumed tail is bit-identical to an uninterrupted epoch's,
+        # AND the stream is invariant to (host_id, num_hosts): resuming
+        # onto a different topology feeds the same augmented samples.
+        # Custom transforms fall back to a per-batch Generator keyed by
+        # (seed, epoch, host, batch index) — resume-safe, but host-
+        # count-dependent.
         for bi in range(start_step, (len(idx) + self.batch_size - 1) // self.batch_size):
             start = bi * self.batch_size
             sel = idx[start : start + self.batch_size]
+            if self._keyed is not None:
+                keys = sample_augment_keys(self.seed, epoch, sel)
+                yield self._keyed(self.ds.images[sel], keys), self.ds.labels[sel]
+                continue
             rng = np.random.default_rng(
                 (self.seed, epoch, self.host_id, 1, bi)
             )
@@ -414,13 +508,14 @@ class ImageFolderPipeline:
             num_hosts=self.num_hosts,
             drop_remainder_to=self.batch_size if self.train else None,
         )
-        # per-sample augment seeds drawn ONCE for the whole epoch, then
-        # sliced per batch: a resumed epoch (start_step > 0) hands batch
-        # k exactly the seeds it would have gotten uninterrupted,
-        # without replaying draws for batches 0..k-1
-        seeds = np.random.default_rng(
-            (self.seed, epoch, self.host_id)
-        ).integers(0, 2**31, size=len(idx))
+        # per-sample augment seeds keyed by (seed, epoch, GLOBAL sample
+        # index), aligned with the shard slice: a resumed epoch
+        # (start_step > 0) hands batch k exactly the seeds it would
+        # have gotten uninterrupted, without replaying draws for
+        # batches 0..k-1 — and a resume onto a different host count
+        # (elastic resume) sees the same per-sample draws, because the
+        # key never involves host_id or stream position
+        seeds = sample_augment_keys(self.seed, epoch, idx)
         with ThreadPoolExecutor(self.num_threads) as pool:
             for start in range(
                 start_step * self.batch_size, len(idx), self.batch_size
@@ -591,9 +686,7 @@ def _stateless_seeds(seed: int, epoch: int, indices: np.ndarray) -> np.ndarray:
             + np.uint64(seed & 0xFFFFFFFF) * np.uint64(0x9E3779B97F4A7C15)
             + np.uint64(epoch) * np.uint64(0xBF58476D1CE4E5B9)
         )
-        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-        z = z ^ (z >> np.uint64(31))
+    z = _splitmix64(z)
     lo = (z & np.uint64(0x7FFFFFFF)).astype(np.int32)
     hi = ((z >> np.uint64(32)) & np.uint64(0x7FFFFFFF)).astype(np.int32)
     return np.stack([lo, hi], axis=-1)

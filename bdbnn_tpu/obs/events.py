@@ -16,20 +16,37 @@ Event kinds emitted by ``fit()``:
   data_wait/dispatch/drain seconds + shares, per-layer ``flip_rate``
   and ``kurtosis`` dicts, ``grad_norm``
 - ``epoch``       — epoch train means + wall seconds
-- ``eval``        — per-validation acc1/acc5/loss
+- ``eval``        — per-validation acc1/acc5/loss + ``count`` (the
+  GLOBAL example total after the cross-host psum — on a pod it must
+  equal the full val-split size, proving eval is sharded over hosts
+  rather than replicated per host)
 - ``nonfinite``   — a drained interval contained non-finite losses
 - ``profile``     — a trace capture window closed (epoch, start_step,
   steps, trace_dir) — `summarize` keys its attribution section on it
 - ``memory``      — HBM watermark poll (obs/memory.py)
 - ``checkpoint``  — a checkpoint committed (epoch-end, step/wallclock
-  interval, or preemption), with the schedule state it froze (LR step,
-  EDE t/k, kurtosis gate) — the fault-injection tests compare these
-  against the resumed run's ``restore`` event bitwise
+  interval, preemption, or forensics), with the schedule state it
+  froze (LR step, EDE t/k, kurtosis gate) — the fault-injection tests
+  compare these against the resumed run's ``restore`` event bitwise.
+  ``coordinated`` records whether the save ran as an aligned
+  collective decided by the multi-process step-boundary agreement
+  (train/resilience.py); the checkpoint's ``resume.json`` sidecar
+  additionally carries the writer's ``topology``
 - ``restore``     — a resume restored state: source dir, integrity
   verdict, ``fallback`` (checkpoint.old used), what was and wasn't
-  restored, and the resume-point schedule state
-- ``preempt``     — SIGTERM/SIGINT latched and the mid-epoch
-  checkpoint landed; the process exits with the preempt code next
+  restored, and the resume-point schedule state. Elastic resumes add
+  the topology lineage: ``topology_from`` (the checkpoint writer's
+  process/device/mesh layout, from its sidecar), ``topology_to`` (the
+  restoring run's layout) and ``resharded`` (the reshard disposition:
+  True when the layouts differ and the global arrays were re-placed
+  onto the current mesh, False for a same-topology resume, null for
+  pre-elastic checkpoints that recorded no topology)
+- ``preempt``     — a preemption signal was agreed on and the
+  mid-epoch checkpoint landed; the process exits with the preempt
+  code next. ``coordinated`` is True on multi-process runs (the
+  signal landed on ONE host; the step-boundary all-reduce spread it
+  so every host saved the same step — ``coordination_step`` — and
+  exits 75 together); ``signum`` is the agreed signal number
 - ``data_error``  — a corrupt/undecodable sample was substituted
   (graceful input degradation, data/pipeline.py) instead of killing
   the run

@@ -113,13 +113,27 @@ class RunManifest:
 
 
 def write_manifest(
-    log_path: str, cfg: Any, extra: Optional[Dict[str, Any]] = None
+    log_path: str,
+    cfg: Any,
+    extra: Optional[Dict[str, Any]] = None,
+    write: bool = True,
 ) -> Dict[str, Any]:
     """Capture + atomically write ``<log_path>/manifest.json``; returns
-    the written dict."""
+    the written dict.
+
+    ``write=False`` captures without touching the filesystem — on a
+    multi-process (pod) run every host shares ONE run dir, so only
+    process 0 writes the manifest (the captured topology fields are
+    identical on every host; ``process_index`` is the one per-host
+    field and the canonical manifest records process 0's). ``extra``
+    carries restart ancestry: ``resumed_from`` / ``restart_lineage``
+    plus, for an elastic resume, ``topology_from`` / ``topology_to``
+    (the writer's vs this run's process/device layout)."""
     man = RunManifest.capture(cfg).to_dict()
     if extra:
         man.update(extra)
+    if not write:
+        return man
     os.makedirs(log_path, exist_ok=True)
     path = os.path.join(log_path, MANIFEST_NAME)
     tmp = path + ".tmp"

@@ -352,12 +352,16 @@ def _resilience(manifest, events) -> Dict[str, Any]:
             {
                 k: r.get(k)
                 for k in ("source", "fallback", "integrity", "epoch",
-                          "step_in_epoch")
+                          "step_in_epoch", "topology_from", "topology_to",
+                          "resharded")
             }
             for r in restores
         ],
         "preempts": [
-            {k: p.get(k) for k in ("signum", "epoch", "step_in_epoch")}
+            {
+                k: p.get(k)
+                for k in ("signum", "epoch", "step_in_epoch", "coordinated")
+            }
             for p in preempts
         ],
         "data_errors": len(data_errors),
@@ -626,11 +630,23 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                 + (", FELL BACK to checkpoint.old" if r.get("fallback") else "")
                 + ")"
             )
+            if r.get("resharded"):
+                tf, tt = r.get("topology_from") or {}, r.get("topology_to") or {}
+                lines.append(
+                    "    elastic resume: "
+                    f"{tf.get('processes')} proc x {tf.get('devices')} dev"
+                    f" -> {tt.get('processes')} proc x {tt.get('devices')}"
+                    " dev (global arrays resharded)"
+                )
         for p in res["preempts"]:
             lines.append(
                 f"  preempted by signal {p.get('signum')} at epoch "
                 f"{p.get('epoch')} step {p.get('step_in_epoch')} "
-                "(mid-epoch checkpoint saved)"
+                + (
+                    "(coordinated pod-wide mid-epoch checkpoint saved)"
+                    if p.get("coordinated")
+                    else "(mid-epoch checkpoint saved)"
+                )
             )
         if res["data_errors"]:
             lines.append(

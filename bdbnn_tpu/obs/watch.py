@@ -94,6 +94,7 @@ def render_status(
     alerts = [e for e in events if e.get("kind") == "alert"]
     ckpts = [e for e in events if e.get("kind") == "checkpoint"]
     preempts = [e for e in events if e.get("kind") == "preempt"]
+    restores = [e for e in events if e.get("kind") == "restore"]
     data_errors = [e for e in events if e.get("kind") == "data_error"]
     restarts = len((manifest or {}).get("restart_lineage") or [])
 
@@ -105,6 +106,21 @@ def render_status(
             f"{start.get('epochs')} | {start.get('steps_per_epoch')} "
             f"steps/epoch | config {start.get('config_hash', '?')}"
             + (f" | restart #{restarts}" if restarts else "")
+        )
+    # elastic-resume lineage: a resharded restore is the one resume
+    # variant worth calling out live (the run now executes on a
+    # different topology than wrote its checkpoint)
+    resharded = next(
+        (r for r in reversed(restores) if r.get("resharded")), None
+    )
+    if resharded:
+        tf = resharded.get("topology_from") or {}
+        tt = resharded.get("topology_to") or {}
+        lines.append(
+            "elastic: resumed "
+            f"{tf.get('processes')}p x {tf.get('devices')}d -> "
+            f"{tt.get('processes')}p x {tt.get('devices')}d "
+            "(checkpoint resharded onto this mesh)"
         )
     last = intervals[-1] if intervals else None
     if last:
@@ -180,9 +196,10 @@ def render_status(
     if preempts:
         p = preempts[-1]
         lines.append(
-            f"!! preempted (signal {p.get('signum')}) at epoch "
-            f"{p.get('epoch')} step {p.get('step_in_epoch')} — resume "
-            "with --resume"
+            f"!! preempted (signal {p.get('signum')}"
+            + (", coordinated pod-wide" if p.get("coordinated") else "")
+            + f") at epoch {p.get('epoch')} step "
+            f"{p.get('step_in_epoch')} — resume with --resume"
         )
     if data_errors:
         lines.append(f"!! corrupt samples substituted: {len(data_errors)}")

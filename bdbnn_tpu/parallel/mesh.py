@@ -160,6 +160,73 @@ def create_sharded_state(mesh: Mesh, variables, tx, state_cls):
     return jax.tree_util.tree_map(_mesh_place, state)
 
 
+# ---------------------------------------------------------------------------
+# Cross-host coordination (pod-grade fault tolerance)
+# ---------------------------------------------------------------------------
+
+
+def topology(mesh: Optional[Mesh] = None) -> dict:
+    """The run's process/device layout as a strict-JSON dict — recorded
+    in checkpoint sidecars (``resume.json``) at save time and compared
+    against the restoring run's layout to detect an ELASTIC resume
+    (restore onto a different topology; ``restore`` event fields
+    ``topology_from`` / ``topology_to`` / ``resharded``)."""
+    out = {
+        "processes": jax.process_count(),
+        "devices": jax.device_count(),
+    }
+    if mesh is not None:
+        out["mesh"] = {
+            "data": int(mesh.shape[DATA_AXIS]),
+            "model": int(mesh.shape[MODEL_AXIS]),
+        }
+    return out
+
+
+def coordinate_flags(values: Sequence[float]) -> np.ndarray:
+    """Cross-host agreement on step-boundary trigger flags: elementwise
+    MAX over every process's local vector.
+
+    This is the primitive behind coordinated preemption (docs/design.md
+    §7): signal delivery is per-process, so hosts latch SIGTERM at
+    different steps — but every host calls this at every step boundary,
+    so the first boundary AFTER any host latched is the SAME boundary
+    on every host, and all processes run the collective save for that
+    step together (barriers align, no mixed-step shards). Max-reduce
+    also broadcasts process-0's wallclock-cadence decision and any
+    host's pending forensics request.
+
+    Single-process runs return the local vector untouched (no
+    collective, no cost). Multi-process runs pay one small allgather
+    per step boundary — noise next to a train step's collectives.
+    MUST be called by every process with a same-length vector (it is a
+    collective op).
+    """
+    local = np.asarray(values, np.float32)
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(local)).max(axis=0)
+
+
+def broadcast_host_int(value: int) -> int:
+    """Process-0's ``value`` on every process (identity when single
+    process). Used to agree on one run-directory timestamp per pod run
+    — per-host clocks may straddle a second boundary, and hosts writing
+    different run dirs would break the collective checkpoint, the
+    shared manifest, and every post-hoc reader."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    return int(
+        multihost_utils.broadcast_one_to_all(
+            np.asarray([value], np.int64)
+        )[0]
+    )
+
+
 def jit_train_step(step_fn) -> Any:
     """Compile a train step for mesh execution.
 

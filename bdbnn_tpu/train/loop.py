@@ -16,7 +16,12 @@ Differences by design (TPU-first):
   train batches, so the reduced metrics are global on every host
   (the reference's ``validate()`` was rank-local);
 - checkpointing via Orbax with best-model copy; scalar logs carry
-  epoch means (Appendix B #15 fix).
+  epoch means (Appendix B #15 fix);
+- multi-process durability is COORDINATED: every step boundary of a
+  collective run agrees on (preempt signal, checkpoint cadence,
+  forensics) via a cross-host max all-reduce, so saves are aligned
+  collectives and a signal on one host exits the whole pod at 75
+  (train/resilience.py module docstring; docs/design.md §7).
 """
 
 from __future__ import annotations
@@ -64,11 +69,14 @@ from bdbnn_tpu.obs import (
 )
 from bdbnn_tpu.obs.probes import NonFiniteLossError, drain_probe_report
 from bdbnn_tpu.parallel import (
+    broadcast_host_int,
+    coordinate_flags,
     create_sharded_state,
     jit_train_step,
     make_mesh,
     shard_batch,
     shard_variables,
+    topology,
 )
 from bdbnn_tpu.train.ede import cpt_tk
 from bdbnn_tpu.train.optim import make_optimizer
@@ -385,6 +393,19 @@ def build_teacher(cfg: RunConfig, image_size: int):
     return teacher, variables
 
 
+def _detector_code(detector: str) -> int:
+    """Stable numeric code for a health-detector name — what rides the
+    coordination all-reduce (floats only) so every host derives the
+    same forensics tag. Unknown names map past the registry (rendered
+    as a generic ``alert`` snapshot)."""
+    from bdbnn_tpu.obs.health import DETECTORS
+
+    try:
+        return DETECTORS.index(detector)
+    except ValueError:
+        return len(DETECTORS)
+
+
 def _pack_host_rng() -> Dict:
     """The legacy np.random global state as strict-JSON scalars (the
     ``resume.json`` sidecar carries it; ~4KB)."""
@@ -410,11 +431,31 @@ def _unpack_host_rng(d: Dict) -> None:
     )
 
 
-def _resume_lineage(resume_path: str) -> Dict:
+def _checkpoint_topology(resume_path: str) -> Optional[Dict]:
+    """The topology recorded in the resume target's checkpoint sidecar
+    (``resume.json``'s ``topology`` field) — None for torch files and
+    pre-elastic checkpoints."""
+    if not resume_path or os.path.isfile(resume_path):
+        return None
+    from bdbnn_tpu.utils.checkpoint import _candidate_dirs, read_resume_state
+
+    for cand in _candidate_dirs(resume_path):
+        if os.path.isdir(cand):
+            topo = read_resume_state(cand).get("topology")
+            if topo:
+                return topo
+    return None
+
+
+def _resume_lineage(resume_path: str, model_parallel: int = 1) -> Dict:
     """Manifest extras recording restart ancestry: ``resumed_from`` (the
     --resume argument) and ``restart_lineage`` (every prior run dir in
     the chain, oldest first — carried forward from the prior run's own
-    manifest, so a thrice-preempted run lists all three ancestors)."""
+    manifest, so a thrice-preempted run lists all three ancestors).
+    Elastic resumes also record ``topology_from`` (the checkpoint
+    writer's process/device layout, from its ``resume.json`` sidecar)
+    and ``topology_to`` (this run's layout) so the topology lineage is
+    auditable from the manifest alone."""
     if not resume_path:
         return {}
     prior_dir = resume_path
@@ -431,10 +472,25 @@ def _resume_lineage(resume_path: str) -> Dict:
                 break
     lineage = list((prior or {}).get("restart_lineage") or [])
     lineage.append(os.path.abspath(prior_dir))
-    return {
+    out = {
         "resumed_from": os.path.abspath(resume_path),
         "restart_lineage": lineage,
     }
+    topo_from = _checkpoint_topology(resume_path)
+    if topo_from is not None:
+        out["topology_from"] = topo_from
+        # the mesh doesn't exist yet at manifest time, but its shape is
+        # a pure function of (device count, model_parallel) — record it
+        # so topology_to compares field-for-field with topology_from
+        # (a mesh-less dict would read as a phantom reshard)
+        topo_to = topology()
+        if model_parallel and topo_to["devices"] % model_parallel == 0:
+            topo_to["mesh"] = {
+                "data": topo_to["devices"] // model_parallel,
+                "model": int(model_parallel),
+            }
+        out["topology_to"] = topo_to
+    return out
 
 
 @dataclasses.dataclass
@@ -442,50 +498,119 @@ class _Resilience:
     """fit()-scoped preemption/cadence bundle threaded into the epoch
     loop. ``save`` is a closure over fit's checkpoint bookkeeping:
     ``save(state, epoch, step_in_epoch, reason)`` commits a checkpoint
-    + emits the ``checkpoint`` event + resets the cadence.
+    + emits the ``checkpoint`` event + resets the cadence;
+    ``save_forensics(state, epoch, step, detector_code)`` snapshots
+    under ``<run_dir>/forensics/``.
 
-    ``collective`` (multi-process run): flag-triggered saves are
-    SKIPPED — the preemption flag latches at a different step on each
-    host, and the collective Orbax save would either hang on its
-    barriers or mix shards from different steps. Pods rely on the
-    step-count-keyed ``--save-every-steps`` cadence (deterministic, so
-    every host saves at the same step) for mid-epoch durability."""
+    ``collective`` (multi-process run): every step boundary runs a
+    COORDINATION step — each host's local trigger vector (latched
+    signal number, wallclock/step cadence decision, pending forensics
+    request) goes through a cross-host max all-reduce
+    (:func:`bdbnn_tpu.parallel.coordinate_flags`), so every process
+    acts on the SAME agreed triggers at the SAME step and the
+    collective Orbax save's barriers align. This is what makes
+    flag-triggered preemption saves, ``--save-every-mins`` (process-0's
+    clock, broadcast by the all-reduce) and forensics snapshots safe on
+    pods — the per-host-flag carve-outs of PR 3/4 are gone. Single-
+    process runs skip the all-reduce entirely (the local vector IS the
+    agreement)."""
 
     handler: PreemptionHandler
     policy: CheckpointPolicy
     save: Any
     events: EventWriter
     collective: bool = False
+    clock_leader: bool = True
+    save_forensics: Any = None
+    # pending coordinated-forensics request: health-detector code + 1
+    # (0 = none) — set by the forensics hook on collective runs,
+    # consumed at the next step boundary's agreement
+    forensics_request: int = 0
+
+    def request_forensics(self, detector_code: int) -> None:
+        """Latch a forensics-snapshot request (collective runs): the
+        alert fired at THIS host's drain, but the aligned save must
+        happen at a step boundary every host agrees on."""
+        self.forensics_request = int(detector_code) + 1
+
+    def _agree(self, cadence_due: bool):
+        """One coordination step: (signum, cadence, forensics_code)
+        agreed across all processes (elementwise max). On collective
+        runs this is a collective op — every process must call it at
+        the same point in its step sequence."""
+        local = (
+            float(self.handler.signum or 0),
+            1.0 if cadence_due else 0.0,
+            float(self.forensics_request),
+        )
+        if not self.collective:
+            return int(local[0]), bool(cadence_due), int(local[2])
+        agreed = coordinate_flags(local)
+        return int(agreed[0]), bool(agreed[1] >= 1.0), int(agreed[2])
 
     def preempt_exit(
         self, state, epoch: int, step_in_epoch: int,
-        already_durable: bool = False,
+        already_durable: bool = False, signum: Optional[int] = None,
     ) -> None:
         """The preemption exit protocol: make the state durable (unless
-        a checkpoint of exactly this state just committed, or the save
-        would be an unaligned collective), emit ``preempt``, raise."""
+        a checkpoint of exactly this state just committed), emit
+        ``preempt``, raise. On collective runs the caller passes the
+        AGREED ``signum`` (the local handler may never have latched —
+        the signal landed on another host) and every process runs the
+        aligned collective save together."""
+        signum = int(signum or self.handler.signum or 0)
         target_epoch = epoch if step_in_epoch else epoch + 1
-        saved = already_durable
-        if not already_durable and not self.collective:
+        if not already_durable:
             self.save(state, epoch, step_in_epoch, "preempt")
-            saved = True
         self.events.emit(
             "preempt",
-            signum=self.handler.signum,
+            signum=signum,
             epoch=target_epoch,
             step_in_epoch=step_in_epoch,
-            saved=saved,
+            saved=True,
+            coordinated=self.collective,
+            coordination_step=step_in_epoch,
         )
-        raise PreemptedError(self.handler.signum, target_epoch, step_in_epoch)
+        raise PreemptedError(signum, target_epoch, step_in_epoch)
 
     def after_step(self, state, epoch: int, next_step: int) -> None:
         """Called at each step boundary (state consistent, saveable).
-        Preemption → final mid-epoch checkpoint, ``preempt`` event,
-        raise; cadence due → mid-epoch checkpoint and continue."""
-        if self.handler.preempted:
-            self.preempt_exit(state, epoch, next_step)
-        if self.policy.active and self.policy.step():
+        Agreed preemption → final mid-epoch checkpoint, ``preempt``
+        event, raise; agreed forensics → aligned snapshot; agreed
+        cadence → mid-epoch checkpoint and continue."""
+        cadence_due = False
+        if self.policy.active:
+            self.policy.tick()
+            cadence_due = self.policy.due(clock_leader=self.clock_leader)
+        signum, cadence, forensic = self._agree(cadence_due)
+        if signum:
+            self.preempt_exit(state, epoch, next_step, signum=signum)
+        if forensic and self.save_forensics is not None:
+            self.forensics_request = 0
+            self.save_forensics(state, epoch, next_step, forensic - 1)
+        if cadence:
             self.save(state, epoch, next_step, "interval")
+
+    def poll_boundary(self, state=None, epoch: int = 0,
+                      boundary_step: int = 0) -> int:
+        """Coordinated check at an epoch boundary (no cadence tick —
+        the epoch-end save is imminent). Returns the agreed signal
+        number, 0 when no host latched. A forensics request latched at
+        the epoch's FINAL drain (the one step with no ``after_step``)
+        is consumed here too when ``state`` is given, so the promised
+        snapshot cannot be silently dropped at a run's last epoch.
+        Every process must call this at the same loop point (it
+        coordinates)."""
+        signum, _, forensic = self._agree(False)
+        if (
+            forensic
+            and state is not None
+            and self.save_forensics is not None
+            and not signum  # preemption wins: its save is imminent
+        ):
+            self.forensics_request = 0
+            self.save_forensics(state, epoch, boundary_step, forensic - 1)
+        return signum
 
 
 def fit(cfg: RunConfig) -> Dict[str, float]:
@@ -508,19 +633,49 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     if cfg.distributed_init:
         jax.distributed.initialize()
 
-    log_path = make_log_dir(cfg.log_path, cfg.w_kurtosis_target)
-    logger = setup_logger(log_path)
-    writer = ScalarWriter(log_path)
+    # pod runs share ONE run dir across hosts: the collective Orbax
+    # save, the manifest and the event timeline all assume a single
+    # directory, and per-host clocks can straddle a second boundary —
+    # so the timestamp is process-0's, broadcast to everyone
+    primary = jax.process_index() == 0
+    proc = jax.process_index()
+    stamp = None
+    if jax.process_count() > 1:
+        # gmtime, not localtime: the broadcast only fixes clock skew —
+        # hosts with different TZ env would still format the same
+        # instant into different dir names
+        stamp = time.strftime(
+            "%Y-%m-%d_%H-%M-%S",
+            time.gmtime(broadcast_host_int(int(time.time()))),
+        )
+    log_path = make_log_dir(cfg.log_path, cfg.w_kurtosis_target, stamp=stamp)
+    logger = setup_logger(
+        log_path, filename="log.txt" if primary else f"log.p{proc}.txt"
+    )
+    writer = ScalarWriter(
+        log_path,
+        name="scalars.jsonl" if primary else f"scalars.p{proc}.jsonl",
+        tensorboard=primary,
+    )
     _resources.append(writer)
     logger.info("config: %s", cfg)
 
     # unified telemetry: provenance manifest + structured event channel
     # live next to log.txt/scalars.jsonl from the first moment of the
     # run, so even a crashed run is diagnosable post hoc (`summarize`)
-    # — including restart ancestry when this run resumes another
-    manifest = write_manifest(log_path, cfg, extra=_resume_lineage(cfg.resume))
+    # — including restart ancestry when this run resumes another.
+    # Metrics are global (GSPMD-reduced on every host), so process 0's
+    # events.jsonl is the canonical timeline readers consume; the other
+    # hosts write per-process events.p<i>.jsonl for forensics
+    manifest = write_manifest(
+        log_path, cfg,
+        extra=_resume_lineage(cfg.resume, cfg.model_parallel),
+        write=primary,
+    )
     events = EventWriter(
-        log_path, max_bytes=int(cfg.events_max_mb * 2**20)
+        log_path,
+        name="events.jsonl" if primary else f"events.p{proc}.jsonl",
+        max_bytes=int(cfg.events_max_mb * 2**20),
     )
     _resources.append(events)
     logger.info(
@@ -850,6 +1005,28 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                     "committed checkpoint unusable; restored the "
                     "previous one from %s", restored["source"],
                 )
+            # elastic-resume lineage: the checkpoint records its
+            # writer's topology; compare with ours to flag a reshard.
+            # The restore itself is topology-portable (global arrays,
+            # re-placed per the current mesh's NamedSharding) and the
+            # (epoch, step) cursor is global, so a reshard needs no
+            # special handling beyond being RECORDED.
+            topo_from = restored.get("topology")
+            topo_to = topology(mesh)
+            resharded = None
+            if topo_from:
+                resharded = (
+                    int(topo_from.get("processes", -1)) != topo_to["processes"]
+                    or int(topo_from.get("devices", -1)) != topo_to["devices"]
+                    or (topo_from.get("mesh") or topo_to["mesh"])
+                    != topo_to["mesh"]
+                )
+            if resharded:
+                logger.info(
+                    "elastic resume: checkpoint written by %s restored "
+                    "onto %s (global arrays resharded to the current "
+                    "mesh)", topo_from, topo_to,
+                )
             ede_t, ede_k, kurt_gate = _sched(start_epoch)
             events.emit(
                 "restore",
@@ -863,6 +1040,9 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 ede_t=ede_t,
                 ede_k=ede_k,
                 kurt_gate=kurt_gate,
+                topology_from=topo_from,
+                topology_to=topo_to,
+                resharded=resharded,
                 restored=[
                     "params", "batch_stats", "opt_state", "lr_step",
                     "epoch", "best_acc1", "best_epoch", "step_in_epoch",
@@ -916,47 +1096,74 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
 
     forensics_used = [0]
 
+    def _save_forensics_ckpt(st, epoch, step_cursor, detector_code):
+        """Forensics snapshot under ``<run_dir>/forensics/`` with full
+        resume state — restorable like any checkpoint. Single-process
+        runs call this inline at the alerting drain; collective runs
+        call it from the NEXT step boundary's coordination agreement
+        (every host passes the same coordinated (epoch, step, detector)
+        and the collective save's barriers align)."""
+        from bdbnn_tpu.obs.health import DETECTORS
+
+        detector = (
+            DETECTORS[detector_code]
+            if 0 <= detector_code < len(DETECTORS)
+            else "alert"
+        )
+        tag = f"{detector}_e{epoch}_s{step_cursor}"
+        t0 = time.time()
+        ede_t, ede_k, kg = _sched(epoch)
+        path = save_checkpoint(
+            os.path.join(log_path, "forensics", tag), st,
+            epoch=epoch, arch=cfg.arch, best_acc1=best_acc1,
+            is_best=False, step_in_epoch=step_cursor,
+            resume_state={
+                "best_epoch": int(best_epoch),
+                "host_rng": _pack_host_rng(),
+                "lr_step": int(jax.device_get(st.step)),
+                "ede_t": ede_t,
+                "ede_k": ede_k,
+                "kurt_gate": kg,
+                "topology": topology(mesh),
+            },
+        )
+        events.emit(
+            "checkpoint",
+            reason="forensics",
+            detector=detector,
+            coordinated=jax.process_count() > 1,
+            epoch=epoch,
+            step_in_epoch=step_cursor,
+            lr_step=int(jax.device_get(st.step)),
+            path=path,
+            seconds=round(time.time() - t0, 3),
+        )
+        return path
+
     def _forensics(st, epoch, step_cursor, alerts):
         """An alert fired at a drain: snapshot the live state under
         <run_dir>/forensics/ (the main checkpoint chain is untouched)
         and schedule a bounded trace window over the NEXT steps, so
         the step-level evidence exists the moment the pathology does.
-        Bounded by --health-max-forensics; collective (multi-process)
-        runs skip the checkpoint (an alert-triggered Orbax save is an
-        unaligned collective — same constraint as flag-triggered
-        preemption saves) but still capture the per-host trace."""
+        Bounded by --health-max-forensics. Collective (multi-process)
+        runs DEFER the checkpoint to the next step boundary's
+        coordination all-reduce (detectors with host-local inputs like
+        throughput can fire on ONE host, and a unilateral Orbax save
+        would be an unaligned collective) — the per-host trace window
+        is still scheduled immediately."""
         if not forensics_on or forensics_used[0] >= cfg.health_max_forensics:
             return
         forensics_used[0] += 1
         detector = alerts[0]["detector"]
-        tag = f"{detector}_e{epoch}_s{step_cursor}"
-        t0 = time.time()
         path = None
         if jax.process_count() == 1:
-            ede_t, ede_k, kg = _sched(epoch)
-            path = save_checkpoint(
-                os.path.join(log_path, "forensics", tag), st,
-                epoch=epoch, arch=cfg.arch, best_acc1=best_acc1,
-                is_best=False, step_in_epoch=step_cursor,
-                resume_state={
-                    "best_epoch": int(best_epoch),
-                    "host_rng": _pack_host_rng(),
-                    "lr_step": int(jax.device_get(st.step)),
-                    "ede_t": ede_t,
-                    "ede_k": ede_k,
-                    "kurt_gate": kg,
-                },
+            path = _save_forensics_ckpt(
+                st, epoch, step_cursor, _detector_code(detector)
             )
-            events.emit(
-                "checkpoint",
-                reason="forensics",
-                detector=detector,
-                epoch=epoch,
-                step_in_epoch=step_cursor,
-                lr_step=int(jax.device_get(st.step)),
-                path=path,
-                seconds=round(time.time() - t0, 3),
-            )
+        else:
+            # resil is assigned before the epoch loop runs (late-bound
+            # closure); the agreed snapshot lands at the next boundary
+            resil.request_forensics(_detector_code(detector))
         window_at = None
         if tracer is not None:
             # never schedule at/after the epoch's step count: the window
@@ -974,7 +1181,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 tracer.schedule(*window_at, cfg.health_forensics_steps)
         logger.warning(
             "auto-forensics for %s: checkpoint %s, trace window %s",
-            detector, path or "(skipped: collective run)",
+            detector,
+            path or "(deferred to the next coordinated step boundary)",
             f"{cfg.health_forensics_steps} steps from epoch "
             f"{window_at[0]} step {window_at[1]}"
             if window_at is not None
@@ -1023,17 +1231,11 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         probed_layers=list(probe_sizes),
     )
 
-    every_mins = cfg.save_every_mins
-    if every_mins and jax.process_count() > 1:
-        # per-host wallclocks would make hosts trigger the collective
-        # save at DIFFERENT steps — barrier hang or mixed-step shards
-        logger.warning(
-            "--save-every-mins disabled on multi-process runs (per-host "
-            "clocks desynchronize the collective save); use the "
-            "step-count-keyed --save-every-steps instead"
-        )
-        every_mins = 0.0
-    policy = CheckpointPolicy(cfg.save_every_steps, every_mins)
+    # wallclock cadence is pod-safe: process 0 is the clock leader and
+    # its decision rides the step-boundary coordination all-reduce, so
+    # per-host clock skew can no longer desynchronize the collective
+    # save (train/resilience.py module docstring)
+    policy = CheckpointPolicy(cfg.save_every_steps, cfg.save_every_mins)
 
     def _save_ckpt(st, epoch, step_in_epoch, reason, is_best=False):
         """Commit a checkpoint (mid-epoch when step_in_epoch > 0) with
@@ -1057,6 +1259,9 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 "ede_t": ede_t,
                 "ede_k": ede_k,
                 "kurt_gate": kurt_gate,
+                # writer topology: what an elastic resume compares its
+                # own layout against (restore event reshard lineage)
+                "topology": topology(mesh),
             },
         )
         events.emit(
@@ -1068,6 +1273,9 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             ede_t=ede_t,
             ede_k=ede_k,
             kurt_gate=kurt_gate,
+            # True when this save ran as an aligned collective decided
+            # by the step-boundary coordination all-reduce
+            coordinated=jax.process_count() > 1,
             path=path,
             seconds=round(time.time() - t0, 3),
         )
@@ -1080,11 +1288,14 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             start_step, steps_per_epoch, start_epoch,
         )
 
-    with PreemptionHandler() as handler:
-        resil = _Resilience(
-            handler, policy, _save_ckpt, events,
-            collective=jax.process_count() > 1,
-        )
+    handler = PreemptionHandler()
+    resil = _Resilience(
+        handler, policy, _save_ckpt, events,
+        collective=jax.process_count() > 1,
+        clock_leader=primary,
+        save_forensics=_save_forensics_ckpt,
+    )
+    with handler:
         for epoch in range(start_epoch, cfg.epochs):
             t, k = cpt_tk(epoch, cfg.epochs) if cfg.ede else (1.0, 1.0)
             if cfg.ede:
@@ -1102,12 +1313,16 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 start_step=start_step if epoch == start_epoch else 0,
                 resil=resil,
             )
-            if handler.preempted:
-                # the flag landed on the epoch's final step: save NOW,
-                # before validation — at ImageNet scale eval outlasts
-                # the preemption grace period, and SIGKILL mid-eval
-                # would discard the whole epoch
-                resil.preempt_exit(state, epoch, 0)
+            # coordinated epoch-boundary check (the epoch's final step
+            # has no after_step): a flag that landed on ANY host during
+            # the last step means save NOW, before validation — at
+            # ImageNet scale eval outlasts the preemption grace period,
+            # and SIGKILL mid-eval would discard the whole epoch
+            boundary_signum = resil.poll_boundary(
+                state, epoch, steps_per_epoch
+            )
+            if boundary_signum:
+                resil.preempt_exit(state, epoch, 0, signum=boundary_signum)
             acc1 = _validate(
                 eval_step, state, val_pipe, mesh, logger, writer, epoch,
                 fill_dtype=eval_fill_dtype, events=events,
@@ -1149,11 +1364,18 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             )
             _save_ckpt(state, epoch, 0, "epoch", is_best=is_best)
 
-            if handler.preempted:
-                # the signal landed during validation/checkpointing —
-                # the epoch-end checkpoint above is already durable, so
-                # exit the preemption protocol without another save
-                resil.preempt_exit(state, epoch, 0, already_durable=True)
+            # the signal landed during validation/checkpointing — the
+            # epoch-end checkpoint above is already durable, so exit
+            # the preemption protocol without another save (coordinated:
+            # all hosts agree before any of them exits)
+            boundary_signum = resil.poll_boundary(
+                state, epoch, steps_per_epoch
+            )
+            if boundary_signum:
+                resil.preempt_exit(
+                    state, epoch, 0, already_durable=True,
+                    signum=boundary_signum,
+                )
 
     if tracer is not None and tracer.unfired():
         # an unreachable spec (epoch resumed past, start step beyond
@@ -1588,12 +1810,17 @@ def _validate(eval_step, state, pipe, mesh, logger, writer, epoch,
     writer.add_scalar("Val Acc1", acc1, epoch)
     writer.add_scalar("Val Acc5", acc5, epoch)
     if events is not None:
+        # count is the GLOBAL example total (GSPMD psums each host's
+        # masked shard): on a pod it must equal the full val-split
+        # size, which is how the fault-matrix tests prove eval is
+        # sharded over hosts rather than replicated per host
         events.emit(
             "eval",
             epoch=epoch,
             acc1=round(acc1, 4),
             acc5=round(acc5, 4),
             loss=round(loss_sum / count, 6),
+            count=int(float(fetched.get("count", 0.0))),
         )
     # the loss is the eval-side NaN signal (acc1 is a ratio of boolean
     # correct-counts and is finite for any weights); "ignore" mirrors

@@ -23,13 +23,22 @@ in :mod:`bdbnn_tpu.utils.checkpoint`.
   (``EX_TEMPFAIL``: "transient failure, retry me"), which is what pod
   schedulers key restart-vs-fail decisions on.
 
-Multi-host caveat (documented, not hidden): signal *delivery* is
-per-process, so hosts latch the preemption flag at different steps. A
-flag-triggered collective save would hang on its barriers (or mix
-shards from different steps), so on multi-process runs the train loop
-SKIPS flag-triggered saves and wallclock cadence entirely — only the
-step-count-keyed ``--save-every-steps`` cadence (deterministic, every
-host saves at the same step) provides mid-epoch durability on pods.
+Multi-host: signal *delivery* is per-process, so hosts latch the
+preemption flag at different steps — acting on the local flag alone
+would misalign the collective save (barrier hang, or shards from
+different steps). The train loop therefore runs a COORDINATION step at
+every step boundary of a multi-process run: each host contributes its
+local trigger vector (latched signal, wallclock-cadence decision,
+pending forensics request) to a cross-host max all-reduce
+(:func:`bdbnn_tpu.parallel.coordinate_flags`), so every process sees
+the same agreed triggers at the same step and runs the collective save
+together. Process 0 is the wallclock leader: only its clock feeds the
+``--save-every-mins`` decision, and the all-reduce broadcasts it — no
+per-host clock skew can desynchronize the cadence. The step-count
+cadence needs no leader (it is deterministic in completed steps).
+:meth:`CheckpointPolicy.due`'s ``clock_leader`` flag implements the
+split; the agreement itself lives in the train loop
+(``train/loop.py``), keeping this module stdlib-only.
 
 Stdlib-only: importable without jax/numpy (the CLI maps the exit code
 before any backend exists).
@@ -113,10 +122,12 @@ class CheckpointPolicy:
 
     ``every_steps`` triggers after N completed steps since the last
     save (deterministic across hosts); ``every_mins`` triggers once the
-    wallclock interval elapses (per-host clock — combine with
-    step-interval saves on pods, see module docstring). Either can be 0
-    (off); with both 0 the policy is inert (``active`` False) and the
-    loop skips the per-step bookkeeping entirely.
+    wallclock interval elapses. On multi-process runs only process 0's
+    clock feeds the wallclock decision (``due(clock_leader=False)`` on
+    the others) and the train loop's coordination all-reduce broadcasts
+    it, so pods get wallclock cadence without trusting per-host clocks.
+    Either can be 0 (off); with both 0 the policy is inert (``active``
+    False) and the loop skips the per-step bookkeeping entirely.
     """
 
     def __init__(
@@ -135,16 +146,29 @@ class CheckpointPolicy:
     def active(self) -> bool:
         return bool(self.every_steps or self.every_secs)
 
-    def step(self) -> bool:
-        """Record one completed step; True when a save is due."""
+    def tick(self) -> None:
+        """Record one completed step."""
         self._steps_since += 1
+
+    def due(self, clock_leader: bool = True) -> bool:
+        """True when a save is due. ``clock_leader``: whether THIS
+        process's wallclock may decide (process 0 on pods; the
+        coordination all-reduce carries the decision to the rest)."""
         if self.every_steps and self._steps_since >= self.every_steps:
             return True
-        if self.every_secs and (
-            self._clock() - self._last_save
-        ) >= self.every_secs:
+        if (
+            clock_leader
+            and self.every_secs
+            and (self._clock() - self._last_save) >= self.every_secs
+        ):
             return True
         return False
+
+    def step(self) -> bool:
+        """Record one completed step; True when a save is due (the
+        single-process convenience wrapper over tick + due)."""
+        self.tick()
+        return self.due()
 
     def note_saved(self) -> None:
         """Reset both cadences (call after ANY save, incl. epoch-end)."""

@@ -50,6 +50,25 @@ Multi-host: two paths, selected automatically.
   save itself is not retried — replaying a barrier-synchronized op
   after a partial failure is not safe; only the process-0 local commit
   retries.)
+
+Elastic (topology-portable) restore: checkpoints store GLOBAL arrays
+(Orbax zarr — the on-disk layout does not encode the writer's device
+or process count), so :func:`load_checkpoint` restores a checkpoint
+written by an N-device/M-process run against a template built on ANY
+topology: the distributed path hands Orbax the template leaves'
+``NamedSharding`` via ``construct_restore_args`` (each process reads
+only the shards it now owns), and the local path materializes host
+arrays and ``device_put``\\ s them per the template — either way the
+restored global values are bitwise those that were saved. The
+``resume.json`` sidecar records the WRITER's topology
+(``{"processes", "devices", "mesh"}`` via
+:func:`bdbnn_tpu.parallel.topology`); the train loop compares it with
+its own to emit the ``restore`` event's ``topology_from`` /
+``topology_to`` / ``resharded`` lineage. The (epoch, step_in_epoch)
+cursor stays valid across topology changes because steps are GLOBAL:
+the global batch size is fixed by config, each pipeline re-derives its
+per-host slice for the new host count, and the per-sample augment keys
+(data/pipeline.py) are host-count-invariant.
 """
 
 from __future__ import annotations
@@ -347,6 +366,39 @@ def save_checkpoint(
     return target
 
 
+def _restore_untemplated(ckpt_dir: str):
+    """Template-free restore to HOST arrays, portable across the
+    writer's topology.
+
+    A plain ``restore(dir)`` asks Orbax to rebuild the leaves with the
+    shardings recorded at save time — impossible when the checkpoint
+    was written by a different process/device layout (the export and
+    teacher-load paths must read pod checkpoints from a laptop). The
+    checkpoint's own metadata tree tells us which leaves are arrays;
+    request those as plain numpy and everything else (scalars,
+    strings) as-is. Falls back to the plain restore for checkpoints
+    whose metadata Orbax cannot describe (older formats)."""
+    ckptr = _checkpointer()
+    try:
+        import numpy as np
+
+        meta = ckptr.metadata(ckpt_dir)
+
+        def to_args(m):
+            # ScalarMetadata subclasses ArrayMetadata — keep scalars
+            # (epoch, best_acc1) as python scalars, not 0-d arrays
+            if isinstance(m, ocp.metadata.ScalarMetadata):
+                return ocp.RestoreArgs()
+            if isinstance(m, ocp.metadata.ArrayMetadata):
+                return ocp.RestoreArgs(restore_type=np.ndarray)
+            return ocp.RestoreArgs()
+
+        restore_args = jax.tree_util.tree_map(to_args, meta)
+        return ckptr.restore(ckpt_dir, restore_args=restore_args)
+    except Exception:
+        return ckptr.restore(ckpt_dir)
+
+
 def load_variables(path: str) -> Dict[str, Any]:
     """Load ``{'params', 'batch_stats'}`` (host arrays) from a native
     checkpoint — e.g. to use a ``fit()``-trained float twin as a frozen
@@ -361,7 +413,7 @@ def load_variables(path: str) -> Dict[str, Any]:
     best = os.path.join(path, BEST_NAME)
     if os.path.isdir(best):
         path = best
-    payload = _checkpointer().restore(_candidate_dirs(path)[0])
+    payload = _restore_untemplated(_candidate_dirs(path)[0])
     state = payload.get("state", payload) if isinstance(payload, dict) else payload
     if not isinstance(state, dict) or "params" not in state:
         raise ValueError(
@@ -398,7 +450,7 @@ def load_export_payload(path: str) -> Dict[str, Any]:
             failures.append(f"{cand}: integrity digest mismatch")
             continue
         try:
-            payload = _checkpointer().restore(cand)
+            payload = _restore_untemplated(cand)
         except Exception as e:  # orbax raises various types on torn dirs
             failures.append(f"{cand}: {type(e).__name__}: {e}")
             continue
@@ -546,6 +598,7 @@ def load_checkpoint(
             "step_in_epoch": 0,
             "best_epoch": -1,
             "host_rng": None,
+            "topology": None,
             **meta,
         }
     state = state.replace(
@@ -561,11 +614,26 @@ def load_checkpoint(
         "step_in_epoch": int(sidecar.get("step_in_epoch", 0)),
         "best_epoch": int(sidecar.get("best_epoch", -1)),
         "host_rng": sidecar.get("host_rng"),
+        # the WRITER's process/device layout (None on pre-elastic
+        # checkpoints) — the caller compares against its own topology
+        # for the restore event's reshard lineage
+        "topology": sidecar.get("topology"),
         **meta,
     }
 
 
 def _restore_payload(ckpt_dir: str, state_template, distributed: bool):
+    """Orbax restore against the (host or device) template.
+
+    BOTH paths pass explicit per-leaf restore args. Without them Orbax
+    falls back to the shardings recorded at SAVE time — which name
+    the writer's devices/processes and make the checkpoint restorable
+    only onto the exact topology that wrote it (restoring a 2-process
+    pod checkpoint on one host fails with "available devices are
+    different"). With them the global arrays deserialize into whatever
+    layout the CURRENT template asks for: the distributed path requests
+    the template leaves' ``NamedSharding``, the local path requests
+    plain numpy — elastic restore either way."""
     if distributed:
         template = {
             "epoch": 0,
@@ -583,4 +651,7 @@ def _restore_payload(ckpt_dir: str, state_template, distributed: bool):
         "best_acc1": 0.0,
         "state": jax.device_get(state_template),
     }
-    return _checkpointer().restore(ckpt_dir, item=template)
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    return _checkpointer().restore(
+        ckpt_dir, item=template, restore_args=restore_args
+    )

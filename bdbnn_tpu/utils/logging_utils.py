@@ -23,16 +23,27 @@ import os
 from typing import Optional
 
 
-def make_log_dir(log_root: str, kurtosis_target) -> str:
-    """``log/<kurt_target>/<YYYY-mm-dd_HH-MM-SS>`` (↔ train.py:189-190)."""
-    stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+def make_log_dir(log_root: str, kurtosis_target, stamp: Optional[str] = None) -> str:
+    """``log/<kurt_target>/<YYYY-mm-dd_HH-MM-SS>`` (↔ train.py:189-190).
+
+    ``stamp`` overrides the local-clock timestamp — multi-process runs
+    pass process-0's broadcast clock so EVERY pod host lands in the
+    same run dir (the collective checkpoint, shared manifest and event
+    timeline all require one directory per run, and per-host clocks can
+    straddle a second boundary)."""
+    if stamp is None:
+        stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
     path = os.path.join(log_root, str(kurtosis_target), stamp)
     os.makedirs(path, exist_ok=True)
     return path
 
 
-def setup_logger(log_path: str, name: str = "bdbnn") -> logging.Logger:
-    """Console + ``<log_path>/log.txt`` file handler (↔ train.py:221-227)."""
+def setup_logger(
+    log_path: str, name: str = "bdbnn", filename: str = "log.txt"
+) -> logging.Logger:
+    """Console + ``<log_path>/<filename>`` file handler (↔
+    train.py:221-227). Non-primary pod hosts pass ``log.p<i>.txt`` so
+    all hosts share one run dir without interleaving one text log."""
     logger = logging.getLogger(name)
     logger.setLevel(logging.INFO)
     logger.handlers.clear()
@@ -42,7 +53,7 @@ def setup_logger(log_path: str, name: str = "bdbnn") -> logging.Logger:
     logger.addHandler(sh)
     if log_path:
         os.makedirs(log_path, exist_ok=True)
-        fh = logging.FileHandler(os.path.join(log_path, "log.txt"))
+        fh = logging.FileHandler(os.path.join(log_path, filename))
         fh.setFormatter(fmt)
         logger.addHandler(fh)
     return logger
@@ -50,14 +61,27 @@ def setup_logger(log_path: str, name: str = "bdbnn") -> logging.Logger:
 
 class ScalarWriter:
     """TensorBoard writer when available, JSONL otherwise (always also
-    JSONL so metrics are machine-readable regardless)."""
+    JSONL so metrics are machine-readable regardless).
 
-    def __init__(self, log_path: str):
+    ``name``/``tensorboard``: non-primary pod hosts write per-process
+    ``scalars.p<i>.jsonl`` with TensorBoard off — metrics are global
+    (GSPMD-reduced) so process 0's file is the canonical one readers
+    consume; the per-process copies exist for forensics only."""
+
+    def __init__(
+        self,
+        log_path: str,
+        name: str = "scalars.jsonl",
+        tensorboard: bool = True,
+    ):
         self.log_path = log_path
         os.makedirs(log_path, exist_ok=True)
-        self._jsonl = open(os.path.join(log_path, "scalars.jsonl"), "a")
+        self._jsonl = open(os.path.join(log_path, name), "a")
         self._tb = None
-        for mod in ("tensorboardX", "torch.utils.tensorboard"):
+        mods = (
+            ("tensorboardX", "torch.utils.tensorboard") if tensorboard else ()
+        )
+        for mod in mods:
             try:
                 import importlib
 

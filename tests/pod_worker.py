@@ -1,0 +1,59 @@
+"""One simulated POD HOST for the fault-injection matrix
+(tests/test_pod_faults.py) — the pattern proven by
+tests/multihost_worker.py, pointed at the REAL CLI entry point.
+
+Run as::
+
+    python pod_worker.py <proc_id> <num_procs> <port> <devices> CLI_ARG...
+
+Each process owns ``<devices>`` virtual CPU devices, joins a real
+``jax.distributed`` cluster over a GRPC coordinator with gloo CPU
+collectives (exactly the multi-host bring-up a TPU pod uses), then
+hands control to ``bdbnn_tpu.cli.main`` with the remaining argv — so
+the process under test runs the full production path: shared run dir
+(process-0 timestamp broadcast), coordinated step-boundary trigger
+agreement, collective checkpoint saves, sharded eval. The process
+exits with ``cli.main``'s return code, which is how the parent test
+asserts that EVERY host — signaled or not — exits 75 (EX_TEMPFAIL)
+after a coordinated preemption save.
+"""
+
+import os
+import re
+import sys
+
+proc_id, num_procs, port, devices = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+)
+cli_args = sys.argv[5:]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# force OUR device count: the parent test session exports =8, but a pod
+# host owns only its own slice of the pod's chips
+flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+",
+    "",
+    os.environ.get("XLA_FLAGS", ""),
+)
+os.environ["XLA_FLAGS"] = (
+    flags + f" --xla_force_host_platform_device_count={devices}"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# CPU PJRT needs an explicit cross-host collectives impl (gloo); see
+# tests/multihost_worker.py for the full story.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=num_procs,
+    process_id=proc_id,
+)
+
+from bdbnn_tpu.cli import main  # noqa: E402
+
+print(f"POD_WORKER_READY {proc_id}", flush=True)
+rc = main(cli_args)
+print(f"POD_WORKER_EXIT {proc_id} {rc}", flush=True)
+sys.exit(rc)

@@ -1,0 +1,104 @@
+"""Resharded-restore worker (tests/test_faults.py::TestReshardedResume).
+
+Run as::
+
+    python reshard_worker.py <devices> <victim_run_dir> [CLI_ARG...]
+
+A fresh single-process interpreter pinned to ``<devices>`` virtual CPU
+devices — a DIFFERENT topology than the 8-device session that wrote the
+checkpoint. Two phases:
+
+1. **Bitwise restore check**: build the training state template on the
+   new mesh (same arch/optimizer as the fault harness), run the real
+   ``load_checkpoint`` against it, and compare every params/batch_stats
+   leaf against the template-free host read (``load_variables`` — the
+   ground truth for what was saved). Prints ``RESHARD_PARAMS_BITWISE_OK``
+   only if every leaf matches exactly: the elastic restore must change
+   placement, never values.
+2. **Resume to completion** (when CLI args follow): hand control to
+   ``bdbnn_tpu.cli.main`` so the resumed training runs end-to-end on
+   the smaller topology; the parent asserts the run's ``restore`` event
+   lineage and final metrics.
+"""
+
+import os
+import re
+import sys
+
+devices, victim = int(sys.argv[1]), sys.argv[2]
+cli_args = sys.argv[3:]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+",
+    "",
+    os.environ.get("XLA_FLAGS", ""),
+)
+os.environ["XLA_FLAGS"] = (
+    flags + f" --xla_force_host_platform_device_count={devices}"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from bdbnn_tpu.models import create_model  # noqa: E402
+from bdbnn_tpu.parallel import create_sharded_state, make_mesh  # noqa: E402
+from bdbnn_tpu.train import TrainState, make_optimizer  # noqa: E402
+from bdbnn_tpu.utils.checkpoint import (  # noqa: E402
+    CKPT_NAME,
+    load_checkpoint,
+    load_variables,
+)
+
+assert jax.device_count() == devices, jax.device_count()
+
+# the fault-harness recipe (conftest.FAULT_BASE): the template only
+# needs matching STRUCTURE + the new mesh's shardings
+model = create_model("resnet8_tiny", "cifar10")
+variables = model.init(
+    jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True
+)
+tx = make_optimizer(
+    variables["params"], dataset="cifar10", lr=0.05, epochs=2,
+    steps_per_epoch=4,
+)
+mesh = make_mesh()
+state = create_sharded_state(mesh, variables, tx, TrainState)
+
+restored = load_checkpoint(victim, state)
+# ground truth must read the SAME chain load_checkpoint restores
+# (<victim>/checkpoint) — load_variables(run_dir) would prefer
+# model_best/, which diverges if the victim crossed an epoch boundary
+# (and saved a best copy) before the preemption landed
+ground = load_variables(os.path.join(victim, CKPT_NAME))
+
+for name, got_tree, want_tree in (
+    ("params", restored["state"].params, ground["params"]),
+    ("batch_stats", restored["state"].batch_stats, ground["batch_stats"]),
+):
+    got = jax.tree_util.tree_leaves(jax.device_get(got_tree))
+    want = jax.tree_util.tree_leaves(want_tree)
+    assert len(got) == len(want), (name, len(got), len(want))
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), np.asarray(w)), (
+            f"{name} leaf differs after reshard onto {devices} devices"
+        )
+print("RESHARD_PARAMS_BITWISE_OK", flush=True)
+print(
+    "RESHARD_CURSOR",
+    restored["epoch"],
+    restored["step_in_epoch"],
+    (restored.get("topology") or {}).get("devices"),
+    flush=True,
+)
+
+if cli_args:
+    from bdbnn_tpu.cli import main
+
+    rc = main(cli_args)
+    print(f"RESHARD_RESUME_EXIT {rc}", flush=True)
+    sys.exit(rc)

@@ -208,6 +208,83 @@ class TestStrictRfc8259:
         assert lines[1]["preempted"] is False
         assert x["checkpoint_acc1"] is None and s["p50_ms"] == 4.25
 
+    def test_resilience_kind_payloads_roundtrip(self, tmp_path):
+        """The extended pod-resilience payload shapes (train/loop.py):
+        coordinated checkpoint/preempt records and an elastic-resume
+        restore with its topology_from/topology_to/resharded lineage —
+        with adversarial values in the numeric slots. A NaN schedule
+        scalar must land as null, numpy bools/ints must unwrap, and the
+        nested topology dicts must survive strict parsing."""
+        ev = EventWriter(str(tmp_path))
+        c = ev.emit(
+            "checkpoint",
+            reason="preempt",
+            epoch=np.int64(1),
+            step_in_epoch=3,
+            lr_step=np.int64(7),
+            ede_t=np.float32(0.01),
+            ede_k=float("nan"),
+            kurt_gate=0.0,
+            coordinated=np.bool_(True),
+            path="/runs/a/checkpoint",
+            seconds=np.float32(0.4),
+        )
+        p = ev.emit(
+            "preempt",
+            signum=np.int64(15),
+            epoch=1,
+            step_in_epoch=np.int64(3),
+            saved=True,
+            coordinated=np.bool_(True),
+            coordination_step=np.int64(3),
+        )
+        r = ev.emit(
+            "restore",
+            source="/runs/a/checkpoint",
+            format="orbax",
+            fallback=False,
+            integrity="ok",
+            epoch=0,
+            step_in_epoch=3,
+            lr_step=3,
+            ede_t=np.float32("inf"),
+            ede_k=100.0,
+            kurt_gate=0.0,
+            topology_from={
+                "processes": np.int64(2),
+                "devices": np.int64(4),
+                "mesh": {"data": np.int64(4), "model": 1},
+            },
+            topology_to={"processes": 1, "devices": 8,
+                         "mesh": {"data": 8, "model": 1}},
+            resharded=np.bool_(True),
+            restored=["params", "batch_stats"],
+            not_restored=[],
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "checkpoint"
+        assert lines[0]["coordinated"] is True
+        assert lines[0]["ede_k"] is None  # NaN -> null, never a token
+        assert isinstance(lines[0]["lr_step"], int)
+        assert lines[1]["kind"] == "preempt"
+        assert lines[1]["signum"] == 15
+        assert lines[1]["coordination_step"] == 3
+        assert lines[1]["coordinated"] is True
+        assert lines[2]["kind"] == "restore"
+        assert lines[2]["ede_t"] is None  # Inf -> null
+        assert lines[2]["resharded"] is True
+        assert lines[2]["topology_from"] == {
+            "processes": 2, "devices": 4, "mesh": {"data": 4, "model": 1},
+        }
+        assert isinstance(
+            lines[2]["topology_from"]["mesh"]["data"], int
+        )
+        # the emit() return values match what was written
+        assert c["ede_k"] is None and p["signum"] == 15
+        assert r["topology_to"]["devices"] == 8
+
     def test_health_kind_payloads_roundtrip(self, tmp_path):
         """The real alert/health payload shapes the monitor emits
         (obs/health.py), with adversarial values in the numeric slots:

@@ -24,6 +24,12 @@ Three tiers:
   point's schedule state is BITWISE-identical to what the victim
   recorded at save time.
 - **randomized kill matrix** (``slow``) — SIGKILL at random offsets.
+- **resharded restore** (``TestReshardedResume``) — the mid-epoch
+  checkpoint restores onto SMALLER simulated topologies (4-device
+  tier-1, 2-device ``slow``) in fresh subprocesses: bitwise-equal
+  global params at restore, reshard lineage in the ``restore`` event,
+  baseline-equal final metrics. The multi-PROCESS (pod) fault matrix
+  lives in tests/test_pod_faults.py.
 
 Cost control (tier-1 budget): everything runs the 2-stage width-8
 ``resnet8_tiny`` on 4-step synthetic epochs; the baseline fit is a
@@ -47,57 +53,15 @@ import pytest
 
 import jax
 
-from bdbnn_tpu.configs.config import RunConfig
+from conftest import (
+    FAULT_EPOCHS as EPOCHS,
+    FAULT_STEPS_PER_EPOCH as STEPS_PER_EPOCH,
+    fault_cfg as _cfg,
+    fault_cli_args as _cli_args,
+)
 from bdbnn_tpu.train.loop import fit
 from bdbnn_tpu.train.resilience import PREEMPT_EXIT_CODE
 from bdbnn_tpu.utils.checkpoint import CKPT_NAME, load_variables
-
-EPOCHS = 2
-STEPS_PER_EPOCH = 4  # 128 synthetic examples / batch 32
-
-BASE = dict(
-    dataset="cifar10",
-    synthetic=True,
-    synthetic_train_size=128,
-    synthetic_val_size=64,
-    arch="resnet8_tiny",
-    epochs=EPOCHS,
-    batch_size=32,
-    lr=0.05,
-    print_freq=1,
-    seed=0,
-    workers=2,
-    # nontrivial schedule state at the resume point: EDE anneal on, and
-    # the kurtosis gate flips open at epoch 1 — exactly the scalars a
-    # wrong fast-forward would corrupt
-    ede=True,
-    kurtepoch=1,
-    save_every_steps=2,
-)
-
-
-def _cfg(log_path, **kw):
-    return RunConfig(**{**BASE, "log_path": str(log_path), **kw})
-
-
-def _cli_args(log_path):
-    """The CLI surface of ``BASE`` (subprocess + in-process main)."""
-    return [
-        "--synthetic",
-        "--synthetic-train-size", "128",
-        "--synthetic-val-size", "64",
-        "-a", "resnet8_tiny",
-        "--epochs", str(EPOCHS),
-        "-b", "32",
-        "-lr", "0.05",
-        "-p", "1",
-        "--seed", "0",
-        "-j", "2",
-        "--ede",
-        "--kurtepoch", "1",
-        "--save-every-steps", "2",
-        "--log_path", str(log_path),
-    ]
 
 
 def _run_dir(root):
@@ -172,43 +136,42 @@ def _assert_schedule_bitwise(saved_ckpt_event, restore_event):
 
 
 @pytest.fixture(scope="module")
-def baseline(tmp_path_factory):
-    """ONE uninterrupted run; every kill/resume result compares to it."""
-    root = tmp_path_factory.mktemp("baseline")
-    res = fit(_cfg(root))
-    run_dir = _run_dir(root)
-    return {
-        "res": res,
-        "run_dir": run_dir,
-        "params": _final_params(run_dir),
-    }
+def baseline(fault_baseline):
+    """ONE uninterrupted run (session-scoped, shared with the pod
+    matrix in test_pod_faults.py); every kill/resume result compares
+    to it."""
+    return fault_baseline
+
+
+@pytest.fixture(scope="module")
+def preempted(tmp_path_factory):
+    """An in-process CLI run SIGTERMed mid-epoch — shared by the
+    graceful-preemption assertions AND the resharded-restore tests
+    (its mid-epoch checkpoint is the reshard source)."""
+    from bdbnn_tpu.cli import main
+
+    root = tmp_path_factory.mktemp("sigterm")
+
+    def _assassin():
+        # SIGTERM once training is demonstrably mid-epoch (a step
+        # beyond the first has completed and a checkpoint exists to
+        # resume from if the flag lands before the next save)
+        _wait_for_event(
+            root,
+            lambda e: e.get("kind") == "train_interval"
+            and e.get("step", 0) >= 1,
+        )
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=_assassin, daemon=True)
+    t.start()
+    rc = main(_cli_args(root))
+    t.join(timeout=5)
+    return {"rc": rc, "run_dir": _run_dir(root)}
 
 
 class TestSigtermPreemption:
     """Graceful preemption through the real CLI entry point."""
-
-    @pytest.fixture(scope="class")
-    def preempted(self, tmp_path_factory):
-        from bdbnn_tpu.cli import main
-
-        root = tmp_path_factory.mktemp("sigterm")
-
-        def _assassin():
-            # SIGTERM once training is demonstrably mid-epoch (a step
-            # beyond the first has completed and a checkpoint exists to
-            # resume from if the flag lands before the next save)
-            _wait_for_event(
-                root,
-                lambda e: e.get("kind") == "train_interval"
-                and e.get("step", 0) >= 1,
-            )
-            os.kill(os.getpid(), signal.SIGTERM)
-
-        t = threading.Thread(target=_assassin, daemon=True)
-        t.start()
-        rc = main(_cli_args(root))
-        t.join(timeout=5)
-        return {"rc": rc, "run_dir": _run_dir(root)}
 
     def test_exit_code_is_preempt(self, preempted):
         assert preempted["rc"] == PREEMPT_EXIT_CODE == 75
@@ -309,6 +272,69 @@ class TestSigkillResume:
             baseline["res"]["best_acc1"], abs=1e-3
         )
         _assert_params_equal(_final_params(run_dir), baseline["params"])
+
+
+class TestReshardedResume:
+    """Elastic resume across DEVICE-topology changes: the 8-device
+    session's mid-epoch preemption checkpoint restores onto smaller
+    simulated topologies (fresh subprocesses pinned to their own
+    ``--xla_force_host_platform_device_count``). Asserts the elastic
+    contract end to end: bitwise-equal global params at restore (the
+    reshard changes placement, never values — checked in the worker
+    against the template-free host read), bitwise-identical schedule
+    state, reshard lineage in the ``restore`` event, globally-complete
+    sharded eval, and baseline-equal final metrics."""
+
+    def _reshard(self, devices, preempted, baseline, tmp_path):
+        victim_dir = preempted["run_dir"]
+        saved = _events(victim_dir, "checkpoint")[-1]
+        worker = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "reshard_worker.py"
+        )
+        repo_root = os.path.dirname(os.path.dirname(worker))
+        root = tmp_path / "resumed"
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["PYTHONPATH"] = (
+            repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        proc = subprocess.run(
+            [
+                sys.executable, worker, str(devices), victim_dir,
+                *_cli_args(root), "--resume", victim_dir,
+            ],
+            capture_output=True, text=True, env=env, cwd=repo_root,
+            timeout=540,
+        )
+        assert proc.returncode == 0, (
+            f"rc={proc.returncode}\nstdout:{proc.stdout[-1500:]}\n"
+            f"stderr:{proc.stderr[-3000:]}"
+        )
+        # restored values identical to what was saved, on the new mesh
+        assert "RESHARD_PARAMS_BITWISE_OK" in proc.stdout
+
+        run_dir = _run_dir(root)
+        restore = _events(run_dir, "restore")[0]
+        _assert_schedule_bitwise(saved, restore)
+        assert restore["integrity"] == "ok"
+        assert restore["resharded"] is True
+        assert restore["topology_from"]["devices"] == 8
+        assert restore["topology_from"]["processes"] == 1
+        assert restore["topology_to"]["devices"] == devices
+        # sharded eval still counted the FULL val split on the new mesh
+        evals = _events(run_dir, "eval")
+        assert evals and all(e["count"] == 64 for e in evals)
+        # same final eval metrics as the uninterrupted baseline
+        end = _events(run_dir, "run_end")[-1]
+        assert end["best_acc1"] == pytest.approx(
+            baseline["res"]["best_acc1"], abs=1e-3
+        )
+
+    def test_restore_onto_4_devices(self, preempted, baseline, tmp_path):
+        self._reshard(4, preempted, baseline, tmp_path)
+
+    @pytest.mark.slow
+    def test_restore_onto_2_devices(self, preempted, baseline, tmp_path):
+        self._reshard(2, preempted, baseline, tmp_path)
 
 
 @pytest.mark.slow

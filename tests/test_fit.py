@@ -32,6 +32,10 @@ def _cfg(tmp_path, **kw):
 
 
 class TestFitSmoke:
+    # tier-1 budget: resume-from-checkpoint is covered far more
+    # strictly by tests/test_faults.py (events, bitwise schedule,
+    # params equality); this broad smoke rides the slow tier
+    @pytest.mark.slow
     def test_one_epoch_then_resume(self, tmp_path):
         res = fit(_cfg(tmp_path))
         assert np.isfinite(res["best_acc1"])
@@ -89,6 +93,9 @@ class TestFitSmoke:
                 )
             )
 
+    # tier-1 budget: the rejected-case twin below pins the
+    # validation logic; the full logit-only KD fit rides slow
+    @pytest.mark.slow
     def test_ts_mismatched_teacher_ok_for_logit_only_kd(self, tmp_path):
         """The same cross-architecture teacher is fine under --react
         (beta resolves to 0; logit-only KD has no per-layer pairing)."""
@@ -103,6 +110,9 @@ class TestFitSmoke:
         )
         assert np.isfinite(res["best_acc1"])
 
+    # tier-1 budget: TS distillation e2e is covered by the
+    # escape-hatch smoke + the torch-oracle KD loss tests
+    @pytest.mark.slow
     def test_vgg_ts_with_float_twin_teacher(self, tmp_path):
         """vgg_small distilled from its FP twin: the full 4-term TS loss
         runs (conv2..conv6 pair shape-matched; stem skipped)."""
@@ -119,6 +129,9 @@ class TestFitSmoke:
         )
         assert np.isfinite(res["best_acc1"])
 
+    # tier-1 budget: differs from the cifar10 smokes only in the
+    # 100-way head + augment constants (unit-covered in test_data)
+    @pytest.mark.slow
     def test_cifar100_end_to_end(self, tmp_path):
         """The cifar100 recipe (reference loader.py:31-49: 100-way fc,
         same augment constants) runs end-to-end, not just model init."""
@@ -126,6 +139,29 @@ class TestFitSmoke:
         assert np.isfinite(res["best_acc1"])
         assert res["best_acc1"] >= 0.0
 
+    def test_evaluate_only_from_trained_fixture(
+        self, tiny_trained_run_dir, tmp_path
+    ):
+        """-e/--evaluate stays covered in tier-1 at one compile's cost:
+        restore the session's real trained run, one validation pass,
+        {'acc1'} out — the early-return path through the SAME fit()
+        startup (shared-stamp, per-process writers, manifest gating)
+        the pod rework touched."""
+        res = fit(
+            _cfg(
+                tmp_path,
+                evaluate=True,
+                resume=tiny_trained_run_dir,
+                arch="resnet8_tiny",
+                batch_size=16,
+                synthetic_val_size=64,
+            )
+        )
+        assert set(res) == {"acc1"} and np.isfinite(res["acc1"])
+
+    # tier-1 budget: two fit() compiles for one early-return
+    # branch (covered above via the session fixture); rides slow
+    @pytest.mark.slow
     def test_evaluate_only_mode(self, tmp_path):
         """-e/--evaluate (reference train.py:376-379): restore a
         checkpoint, run ONE validation pass, return {'acc1'} without
@@ -145,6 +181,10 @@ class TestFitSmoke:
 
 
 class TestDeviceNormalizeFit:
+    # tier-1 budget: the uint8 device-normalize path is pinned at
+    # unit level (pipelines + step input_norm); the full-fit
+    # combination rides the slow tier
+    @pytest.mark.slow
     def test_fit_with_device_normalize_and_target_acc(self, tmp_path):
         """End-to-end: uint8 pipelines + on-device normalize + the
         north-star time-to-target clock, through the real CIFAR npz

@@ -25,6 +25,10 @@ import sys
 
 import pytest
 
+# conftest skips gloo-marked tests (with a reason) when jaxlib lacks
+# multiprocess CPU collectives
+pytestmark = pytest.mark.gloo
+
 
 def _free_port() -> int:
     with socket.socket() as s:

@@ -15,12 +15,15 @@ from bdbnn_tpu.parallel import (
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
+    broadcast_host_int,
+    coordinate_flags,
     create_sharded_state,
     jit_train_step,
     make_mesh,
     params_shardings,
     shard_batch,
     shard_variables,
+    topology,
 )
 from bdbnn_tpu.train import StepConfig, TrainState, make_optimizer, make_train_step
 
@@ -205,3 +208,61 @@ class TestDPEquivalence:
         placed = shard_variables(mesh, v)
         leaf = jax.tree_util.tree_leaves(placed["params"])[0]
         assert len(leaf.sharding.device_set) == 8
+
+
+class TestCoordinationPrimitives:
+    """Single-process semantics of the pod coordination layer — the
+    collective (gloo) path is exercised by tests/test_pod_faults.py;
+    here the contract is that one process IS its own agreement."""
+
+    def test_coordinate_flags_identity_single_process(self):
+        out = coordinate_flags((15.0, 0.0, 3.0))
+        np.testing.assert_array_equal(out, np.asarray([15.0, 0.0, 3.0],
+                                                      np.float32))
+        assert out.dtype == np.float32
+
+    def test_broadcast_host_int_identity_single_process(self):
+        assert broadcast_host_int(1785735886) == 1785735886
+
+    def test_topology_records_mesh_shape(self):
+        topo = topology(make_mesh())
+        assert topo == {
+            "processes": 1,
+            "devices": 8,
+            "mesh": {"data": 8, "model": 1},
+        }
+        # without a mesh: process/device layout only (manifest extras)
+        assert topology() == {"processes": 1, "devices": 8}
+
+
+class TestCheckpointPolicyLeadership:
+    """CheckpointPolicy's wallclock split: only the clock leader's
+    wallclock may decide (process 0 on pods); the step cadence is
+    deterministic and needs no leader."""
+
+    def test_wallclock_decision_is_leader_only(self):
+        from bdbnn_tpu.train.resilience import CheckpointPolicy
+
+        now = [0.0]
+        pol = CheckpointPolicy(every_mins=1.0, clock=lambda: now[0])
+        pol.tick()
+        now[0] = 61.0
+        assert pol.due(clock_leader=True) is True
+        assert pol.due(clock_leader=False) is False
+
+    def test_step_cadence_needs_no_leader(self):
+        from bdbnn_tpu.train.resilience import CheckpointPolicy
+
+        pol = CheckpointPolicy(every_steps=2)
+        pol.tick()
+        assert pol.due(clock_leader=False) is False
+        pol.tick()
+        assert pol.due(clock_leader=False) is True
+        pol.note_saved()
+        assert pol.due(clock_leader=False) is False
+
+    def test_step_wrapper_back_compat(self):
+        from bdbnn_tpu.train.resilience import CheckpointPolicy
+
+        pol = CheckpointPolicy(every_steps=3)
+        assert [pol.step() for _ in range(3)] == [False, False, True]

@@ -1,0 +1,294 @@
+"""Simulated-POD fault-injection matrix (the acceptance e2e).
+
+Everything in tests/test_faults.py kills ONE process. This module forms
+a real 2-process jax.distributed cluster over gloo CPU collectives
+(2 virtual devices per host — the proven tests/multihost_worker.py
+bring-up) running the REAL CLI entry point, and injects faults into
+individual pod hosts:
+
+- **SIGTERM to ONE host** — the coordinated-preemption acceptance
+  test: the signal latches on host 0 only, the step-boundary
+  coordination all-reduce (parallel/mesh.py:coordinate_flags) spreads
+  it, and BOTH hosts must exit 75 (EX_TEMPFAIL) after committing a
+  SINGLE aligned collective checkpoint. The run also drives
+  ``--save-every-mins`` (process-0 clock, broadcast) — the cadence
+  that was BANNED on multi-process runs before the coordination layer
+  — so the wallclock path produces coordinated mid-epoch saves on a
+  pod in tier-1.
+- **Elastic resume onto a smaller topology** — the victim's pod
+  checkpoint (2 processes x 2 devices) resumes IN-PROCESS on this
+  session's 1 process x 8 devices: bitwise-identical schedule state
+  (epoch, step, lr_step, EDE t/k, kurt gate) between the victim's last
+  ``checkpoint`` event and the resume's ``restore`` event, reshard
+  lineage recorded, sharded eval counting the full val split, and the
+  same final eval metrics as the uninterrupted baseline.
+- **SIGKILL to ONE host** (``slow``) — no cleanup possible on the
+  victim, and the survivor blocks in a collective against a dead peer:
+  the parent reaps both, then proves the last COMMITTED coordinated
+  interval checkpoint resumes to the baseline result. The pod-scope
+  version of test_faults.py's SIGKILL tier.
+
+Cost control: one pod (2 subprocesses) per scenario, smoke-scale
+resnet8_tiny on 4-step synthetic epochs, and the resume/baseline
+comparisons reuse the session-scoped ``fault_baseline`` fixture.
+"""
+
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from conftest import fault_cfg as _cfg, fault_cli_args as _cli_args
+from bdbnn_tpu.train.loop import fit
+from bdbnn_tpu.train.resilience import PREEMPT_EXIT_CODE
+from bdbnn_tpu.utils.checkpoint import CKPT_NAME, verify_integrity
+
+from test_faults import (
+    _assert_schedule_bitwise,
+    _events,
+    _run_dir,
+)
+
+pytestmark = pytest.mark.gloo
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pod_worker.py")
+REPO_ROOT = os.path.dirname(os.path.dirname(WORKER))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_pod(root, num_procs=2, devices=2, extra=()):
+    """Launch one simulated pod: ``num_procs`` worker subprocesses of
+    ``devices`` virtual CPU chips each, all running the real CLI with
+    the fault-harness recipe into a SHARED log root."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    args = _cli_args(root, **dict(extra))
+    return [
+        subprocess.Popen(
+            [
+                sys.executable, WORKER, str(i), str(num_procs), str(port),
+                str(devices), *args,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=REPO_ROOT,
+        )
+        for i in range(num_procs)
+    ]
+
+
+def _wait_for_pod_event(root, predicate, procs, timeout=300.0, poll=0.2):
+    """Poll the shared run dir (process 0's events.jsonl) until an
+    event matches; bail early if every worker already exited."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        hits = glob.glob(
+            os.path.join(str(root), "**", "events.jsonl"), recursive=True
+        )
+        for h in sorted(hits, reverse=True):
+            run_dir = os.path.dirname(h)
+            for e in _events(run_dir):
+                if predicate(e):
+                    return run_dir, e
+        if all(p.poll() is not None for p in procs):
+            return None, None
+        time.sleep(poll)
+    return None, None
+
+
+def _reap(procs, timeout=240):
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs.append((p.returncode, out, err))
+    return outs
+
+
+def _fail_debug(outs):
+    return "\n".join(
+        f"--- worker rc={rc}\nstdout:{out[-1200:]}\nstderr:{err[-2500:]}"
+        for rc, out, err in outs
+    )
+
+
+class TestCoordinatedPreemption:
+    """SIGTERM one host of a 2-process pod -> every host exits 75 with
+    one aligned coordinated checkpoint; resume onto a smaller topology
+    reproduces the uninterrupted run."""
+
+    @pytest.fixture(scope="class")
+    def pod_victim(self, tmp_path_factory):
+        root = tmp_path_factory.mktemp("pod_sigterm")
+        # --save-every-mins at a tiny interval: every boundary's
+        # coordination carries process-0's (always-due) clock decision,
+        # exercising the previously banned wallclock path on a pod.
+        # --save-every-steps off to prove the saves came from the
+        # wallclock cadence, not the step cadence.
+        procs = _spawn_pod(
+            root,
+            extra={"--save-every-mins": "0.0005", "--save-every-steps": None},
+        )
+        try:
+            run_dir, _ = _wait_for_pod_event(
+                root,
+                lambda e: e.get("kind") == "train_interval"
+                and e.get("step", 0) >= 1,
+                procs,
+            )
+            assert run_dir is not None, _fail_debug(_reap(procs, timeout=5))
+            # deliver SIGTERM to host 0 ONLY — host 1 must learn about
+            # it through the coordination all-reduce
+            procs[0].send_signal(signal.SIGTERM)
+            outs = _reap(procs)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return {"run_dir": run_dir, "outs": outs}
+
+    def test_every_host_exits_75(self, pod_victim):
+        rcs = [rc for rc, _, _ in pod_victim["outs"]]
+        assert rcs == [PREEMPT_EXIT_CODE, PREEMPT_EXIT_CODE], _fail_debug(
+            pod_victim["outs"]
+        )
+
+    def test_single_aligned_coordinated_checkpoint(self, pod_victim):
+        run_dir = pod_victim["run_dir"]
+        preempts = _events(run_dir, "preempt")
+        assert len(preempts) == 1
+        p = preempts[0]
+        assert p["signum"] == signal.SIGTERM
+        assert p["coordinated"] is True
+        assert p["coordination_step"] >= 1  # a real step boundary agreed
+        ckpts = _events(run_dir, "checkpoint")
+        assert ckpts, "no checkpoint events from the pod victim"
+        # the wallclock cadence produced coordinated interval saves on
+        # a multi-process run (the lifted --save-every-mins ban)
+        assert any(c["reason"] == "interval" for c in ckpts)
+        assert all(c["coordinated"] is True for c in ckpts)
+        last = ckpts[-1]
+        assert last["reason"] == "preempt" or p["step_in_epoch"] == 0
+        assert last["epoch"] == p["epoch"]
+        assert last["step_in_epoch"] == p["step_in_epoch"]
+        # ONE committed checkpoint chain, integrity-verified — not one
+        # per host, not mixed-step shards
+        ckpt_dir = os.path.join(run_dir, CKPT_NAME)
+        assert os.path.isdir(ckpt_dir)
+        assert verify_integrity(ckpt_dir) == "ok"
+        # host 1 wrote its telemetry to its own per-process channel in
+        # the SAME shared run dir (process-0 timestamp broadcast)
+        assert os.path.exists(os.path.join(run_dir, "events.p1.jsonl"))
+        with open(os.path.join(run_dir, "events.p1.jsonl")) as f:
+            p1 = [json.loads(l) for l in f if l.strip()]
+        p1_pre = [e for e in p1 if e.get("kind") == "preempt"]
+        assert len(p1_pre) == 1
+        # both hosts agreed on the SAME preemption point
+        assert p1_pre[0]["epoch"] == p["epoch"]
+        assert p1_pre[0]["step_in_epoch"] == p["step_in_epoch"]
+        assert not _events(run_dir, "run_end")
+
+    def test_elastic_resume_onto_smaller_topology(
+        self, pod_victim, fault_baseline, tmp_path
+    ):
+        victim_dir = pod_victim["run_dir"]
+        saved = _events(victim_dir, "checkpoint")[-1]
+        # resume IN-PROCESS: this session is 1 process x 8 devices —
+        # fewer hosts than the 2-process pod that wrote the checkpoint
+        res = fit(_cfg(tmp_path / "resumed", resume=victim_dir))
+        run_dir = _run_dir(tmp_path / "resumed")
+
+        restore = _events(run_dir, "restore")[0]
+        _assert_schedule_bitwise(saved, restore)
+        assert restore["integrity"] == "ok"
+        assert restore["fallback"] is False
+        assert restore["resharded"] is True
+        assert restore["topology_from"] == {
+            "processes": 2, "devices": 4, "mesh": {"data": 4, "model": 1},
+        }
+        assert restore["topology_to"]["processes"] == 1
+        assert restore["topology_to"]["devices"] == 8
+
+        # manifest topology lineage rides next to restart_lineage
+        with open(os.path.join(run_dir, "manifest.json")) as f:
+            man = json.load(f)
+        assert man["resumed_from"] == os.path.abspath(victim_dir)
+        assert man["topology_from"]["processes"] == 2
+        assert man["topology_to"]["processes"] == 1
+
+        # sharded eval counted the FULL split after the reshard
+        evals = _events(run_dir, "eval")
+        assert evals and all(e["count"] == 64 for e in evals)
+
+        # same final eval metrics as the uninterrupted baseline
+        assert res["best_acc1"] == pytest.approx(
+            fault_baseline["res"]["best_acc1"], abs=1e-3
+        )
+
+
+@pytest.mark.slow
+class TestPodSigkill:
+    """SIGKILL one pod host right after the first coordinated interval
+    checkpoint commits. The survivor blocks in a collective against a
+    dead peer (reaped by the parent — that is what a pod scheduler
+    does); durability rests entirely on the COMMITTED coordinated
+    checkpoint, which must resume onto this session's topology to the
+    baseline result."""
+
+    def test_sigkill_one_host_then_resume(
+        self, fault_baseline, tmp_path_factory, tmp_path
+    ):
+        root = tmp_path_factory.mktemp("pod_sigkill")
+        procs = _spawn_pod(root)  # step cadence: --save-every-steps 2
+        try:
+            run_dir, ck = _wait_for_pod_event(
+                root,
+                lambda e: e.get("kind") == "checkpoint"
+                and e.get("step_in_epoch", 0) > 0,
+                procs,
+            )
+            assert run_dir is not None, _fail_debug(_reap(procs, timeout=5))
+            procs[0].kill()
+            # the survivor cannot make progress without its peer; give
+            # it a moment to park in the collective, then reap it —
+            # the pod scheduler's job, not the training system's
+            time.sleep(2.0)
+            procs[1].kill()
+            _reap(procs, timeout=60)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        assert ck["coordinated"] is True and ck["reason"] == "interval"
+
+        res = fit(_cfg(tmp_path / "resumed", resume=run_dir))
+        resumed_dir = _run_dir(tmp_path / "resumed")
+        restore = _events(resumed_dir, "restore")[0]
+        saved = [
+            e
+            for e in _events(run_dir, "checkpoint")
+            if e["step_in_epoch"] == restore["step_in_epoch"]
+            and e["epoch"] == restore["epoch"]
+        ][-1]
+        _assert_schedule_bitwise(saved, restore)
+        assert res["best_acc1"] == pytest.approx(
+            fault_baseline["res"]["best_acc1"], abs=1e-3
+        )
