@@ -713,6 +713,166 @@ def serve_bench_main(argv) -> int:
     return 0
 
 
+def serve_http_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli serve-http ARTIFACT [flags]`` — the
+    network front end (serve/http.py): a stdlib asyncio HTTP/1.1
+    server over the AOT engine + priority-aware micro-batcher, with
+    per-tenant token-bucket admission control (429 over-quota vs 503
+    draining/overload), /healthz + /readyz wired to the AOT warmup
+    state and the drain latch, and the PR 5 drain contract over
+    sockets: SIGTERM flips readyz, accepted requests all finish, the
+    per-priority SLO verdict lands last. With ``--scenario`` the
+    traffic-shaped socket load generator drives the server in-process
+    and the verdict gains the client-side zero-dropped cross-check."""
+    import json
+
+    from bdbnn_tpu.configs.config import ServeHttpConfig
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli serve-http",
+        description="Serve an export artifact over HTTP with priority "
+        "classes, tenant quotas and health/readiness endpoints; "
+        "optionally drive it with a traffic-shaped load scenario.",
+    )
+    ap.add_argument("artifact", help="export artifact dir")
+    ap.add_argument("--log-path", default="serve_http_log")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument(
+        "--port", type=int, default=0,
+        help="bind port (default 0 = kernel-assigned; printed at start)",
+    )
+    ap.add_argument(
+        "--priorities", type=int, default=3,
+        help="priority classes (x-priority header, 0 = most important)",
+    )
+    ap.add_argument(
+        "--buckets", type=int, nargs="+", default=[1, 8, 32],
+        help="batch-size buckets AOT-compiled at startup",
+    )
+    ap.add_argument(
+        "--queue-depth", type=int, default=64,
+        help="bounded request queue PER priority class",
+    )
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument(
+        "--default-quota", default="100:200", metavar="RATE[:BURST]",
+        help="token-bucket quota every tenant gets unless overridden "
+        "(requests/s, default 100:200)",
+    )
+    ap.add_argument(
+        "--tenant-quota", action="append", default=[],
+        metavar="TENANT=RATE[:BURST]", dest="tenant_quotas",
+        help="per-tenant quota override (repeatable)",
+    )
+    ap.add_argument(
+        "--scenario", default="",
+        choices=["", "poisson", "diurnal", "flash_crowd", "heavy_tail",
+                 "slow_client"],
+        help="bench mode: drive this arrival process over real sockets "
+        "against the server, then drain and report (default: serve "
+        "until SIGTERM)",
+    )
+    ap.add_argument("--rate", type=float, default=100.0)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument(
+        "--concurrency", type=int, default=16,
+        help="client connections for the socket load generator",
+    )
+    ap.add_argument("--flash-factor", type=float, default=8.0)
+    ap.add_argument("--diurnal-amp", type=float, default=0.8)
+    ap.add_argument("--heavy-sigma", type=float, default=1.5)
+    ap.add_argument("--slow-fraction", type=float, default=0.2)
+    ap.add_argument(
+        "--priority-weights", type=float, nargs="+", default=[],
+        help="request mix per priority class (default 0.1 0.3 0.6)",
+    )
+    ap.add_argument(
+        "--tenants", nargs="+", default=["tenant-a", "tenant-b"],
+        help="tenant names the scenario draws from",
+    )
+    ap.add_argument(
+        "--tenant-weights", type=float, nargs="+", default=[],
+        help="request mix per tenant (default uniform)",
+    )
+    ap.add_argument(
+        "--slo-p99-ms", type=float, default=0.0,
+        help="priority-0 p99 target judged in the verdict (0 = off)",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--out", default="", help="also write the SLO verdict JSON here",
+    )
+    ap.add_argument("--events-max-mb", type=float, default=256.0)
+    args = ap.parse_args(argv)
+
+    _force_jax_platforms()
+
+    from bdbnn_tpu.serve.http import run_serve_http
+
+    cfg = ServeHttpConfig(
+        artifact=args.artifact,
+        log_path=args.log_path,
+        host=args.host,
+        port=args.port,
+        priorities=args.priorities,
+        buckets=tuple(args.buckets),
+        queue_depth=args.queue_depth,
+        max_delay_ms=args.max_delay_ms,
+        default_quota=args.default_quota,
+        tenant_quotas=tuple(args.tenant_quotas),
+        scenario=args.scenario,
+        rate=args.rate,
+        requests=args.requests,
+        concurrency=args.concurrency,
+        flash_factor=args.flash_factor,
+        diurnal_amp=args.diurnal_amp,
+        heavy_sigma=args.heavy_sigma,
+        slow_fraction=args.slow_fraction,
+        priority_weights=tuple(args.priority_weights),
+        tenants=tuple(args.tenants),
+        tenant_weights=tuple(args.tenant_weights),
+        slo_p99_ms=args.slo_p99_ms,
+        seed=args.seed,
+        out=args.out,
+        events_max_mb=args.events_max_mb,
+    )
+    result = run_serve_http(cfg)
+    print(json.dumps(result["verdict"], indent=2, sort_keys=True))
+    print(
+        f"[serve-http] run dir: {result['run_dir']} "
+        f"(listened on {result['host']}:{result['port']})",
+        file=sys.stderr,
+    )
+    failed = result["verdict"].get("requests_failed") or 0
+    if failed:
+        print(
+            f"[serve-http] {failed} request(s) FAILED with engine "
+            "errors (not shed); see the run dir's events",
+            file=sys.stderr,
+        )
+        return 1
+    dropped = (result["verdict"].get("client") or {}).get("dropped") or 0
+    if dropped:
+        # the drain contract's cross-check: a request that got NO
+        # response is a dropped connection, never acceptable
+        print(
+            f"[serve-http] {dropped} request(s) got NO response "
+            "(dropped) — the drain contract was violated",
+            file=sys.stderr,
+        )
+        return 1
+    slo = result["verdict"].get("slo")
+    if slo is not None and not slo.get("met"):
+        print(
+            f"[serve-http] SLO MISSED: priority-0 p99 "
+            f"{slo.get('p99_ms_priority0')}ms > target "
+            f"{slo.get('p99_ms_target_priority0')}ms",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
 _SUBCOMMANDS = {
     "summarize": summarize_main,
     "watch": watch_main,
@@ -720,6 +880,7 @@ _SUBCOMMANDS = {
     "export": export_main,
     "predict": predict_main,
     "serve-bench": serve_bench_main,
+    "serve-http": serve_http_main,
 }
 
 

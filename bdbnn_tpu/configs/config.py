@@ -285,3 +285,139 @@ class ServeBenchConfig:
         if self.events_max_mb < 0:
             raise ValueError("--events-max-mb must be >= 0")
         return self
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeHttpConfig:
+    """Typed configuration of the ``serve-http`` CLI (serve/http.py).
+
+    Same resolve-once contract as ServeBenchConfig: every knob of the
+    network front end — bind address, priority classes, per-class
+    queue bound, tenant quotas, and (in bench mode) the traffic
+    scenario — is validated before any socket or backend exists.
+    """
+
+    artifact: str  # export artifact dir (serve/export.py)
+    log_path: str = "serve_http_log"
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = kernel-assigned ephemeral port
+    # priority classes (0 = most important). Each class gets its OWN
+    # bounded queue of queue_depth slots; the batcher dequeues strict-
+    # priority (serve/batching.py)
+    priorities: int = 3
+    buckets: Tuple[int, ...] = (1, 8, 32)
+    queue_depth: int = 64  # per priority class
+    max_delay_ms: float = 5.0
+    # admission control (serve/admission.py): token-bucket quota every
+    # tenant gets unless overridden — "RATE[:BURST]" in requests/s
+    default_quota: str = "100:200"
+    tenant_quotas: Tuple[str, ...] = ()  # "TENANT=RATE[:BURST]" each
+    # bench mode: "" = serve until SIGTERM; otherwise one of the
+    # loadgen scenarios (poisson | diurnal | flash_crowd | heavy_tail |
+    # slow_client) driven over real sockets against this server
+    scenario: str = ""
+    rate: float = 100.0  # scenario base arrival rate, req/s
+    requests: int = 200
+    concurrency: int = 16  # client connections (socket loadgen)
+    # scenario shape knobs (see loadgen.build_schedule)
+    flash_factor: float = 8.0
+    diurnal_amp: float = 0.8
+    heavy_sigma: float = 1.5
+    slow_fraction: float = 0.2
+    slow_chunks: int = 4
+    slow_gap_ms: float = 20.0
+    # request mix: weight per priority class / per tenant; empty = the
+    # loadgen defaults (thin priority-0, uniform tenants)
+    priority_weights: Tuple[float, ...] = ()
+    tenants: Tuple[str, ...] = ("tenant-a", "tenant-b")
+    tenant_weights: Tuple[float, ...] = ()
+    # SLO judged at verdict time: priority-0 p99 target in ms (0 = off)
+    slo_p99_ms: float = 0.0
+    seed: int = 0
+    out: str = ""  # also write the SLO verdict JSON here
+    stats_interval_s: float = 1.0  # cadence of live `http` stats events
+    max_body_mb: float = 16.0
+    events_max_mb: float = 256.0
+
+    def validate(self) -> "ServeHttpConfig":
+        from bdbnn_tpu.serve.loadgen import SCENARIOS
+
+        if not self.artifact:
+            raise ValueError("serve-http needs an export artifact dir")
+        if self.priorities < 1:
+            raise ValueError("--priorities must be >= 1")
+        if not self.buckets or any(b <= 0 for b in self.buckets):
+            raise ValueError(
+                f"--buckets must be positive ints, got {self.buckets!r}"
+            )
+        if self.queue_depth <= 0:
+            raise ValueError(
+                "--queue-depth must be >= 1 (the per-class bound IS the "
+                "shedding point)"
+            )
+        if self.max_delay_ms < 0:
+            raise ValueError("--max-delay-ms must be >= 0")
+        if self.scenario and self.scenario not in SCENARIOS:
+            raise ValueError(
+                f"unknown --scenario {self.scenario!r} "
+                f"(want one of {SCENARIOS}, or omit to serve until "
+                "SIGTERM)"
+            )
+        if self.scenario:
+            if self.requests <= 0 or self.rate <= 0:
+                raise ValueError(
+                    "--scenario needs --requests > 0 and --rate > 0"
+                )
+            if self.concurrency <= 0:
+                raise ValueError("--concurrency must be >= 1")
+        if self.priority_weights and (
+            len(self.priority_weights) != self.priorities
+            or any(w < 0 for w in self.priority_weights)
+            or sum(self.priority_weights) <= 0
+        ):
+            raise ValueError(
+                "--priority-weights needs one nonnegative weight per "
+                f"priority class ({self.priorities}), summing > 0"
+            )
+        if not self.tenants:
+            raise ValueError("need at least one tenant name")
+        if self.tenant_weights and (
+            len(self.tenant_weights) != len(self.tenants)
+            or any(w < 0 for w in self.tenant_weights)
+            or sum(self.tenant_weights) <= 0
+        ):
+            raise ValueError(
+                "--tenant-weights needs one nonnegative weight per "
+                f"tenant ({len(self.tenants)}), summing > 0"
+            )
+        if not 0.0 <= self.slow_fraction <= 1.0:
+            raise ValueError("--slow-fraction must be in [0, 1]")
+        if self.slo_p99_ms < 0:
+            raise ValueError("--slo-p99-ms must be >= 0 (0 disables)")
+        if self.stats_interval_s <= 0:
+            raise ValueError("--stats-interval-s must be > 0")
+        if self.max_body_mb <= 0:
+            raise ValueError("--max-body-mb must be > 0")
+        if self.events_max_mb < 0:
+            raise ValueError("--events-max-mb must be >= 0")
+        # quota specs fail here, not at the first request
+        from bdbnn_tpu.serve.admission import (
+            parse_quota,
+            parse_tenant_quotas,
+        )
+
+        rate, burst = parse_quota(self.default_quota)
+        if rate < 0 or burst <= 0:
+            raise ValueError(
+                f"--default-quota needs RATE >= 0 and BURST > 0, got "
+                f"{self.default_quota!r}"
+            )
+        for tenant, (t_rate, t_burst) in parse_tenant_quotas(
+            self.tenant_quotas
+        ).items():
+            if t_rate < 0 or t_burst <= 0:
+                raise ValueError(
+                    f"--tenant-quota {tenant}: needs RATE >= 0 and "
+                    f"BURST > 0, got {t_rate}:{t_burst}"
+                )
+        return self

@@ -71,14 +71,57 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("serve_p99_ms", "lower", "rel"),
     ("serve_throughput_rps", "higher", "rel"),
     ("serve_shed_rate", "lower", "rel"),
+    # v2 (serve-http) verdicts: PER-PRIORITY p99 for classes 0-2 (the
+    # front end's default class count; the metric skeleton must stay
+    # static for the deterministic golden verdict, so runs with MORE
+    # classes are judged per-class only up to p2 — classes beyond that
+    # are covered by the aggregate p99/shed_rate alone) — a regression
+    # in a judged class exits 3 even when the aggregate hides it
+    # behind a flood of cheap low-priority traffic — plus the max/min
+    # tenant fairness ratio and the worst tenant's shed rate (both
+    # lower = better, --tol-rel). v1 verdicts leave these None
+    # (skipped).
+    ("serve_p99_ms_p0", "lower", "rel"),
+    ("serve_p99_ms_p1", "lower", "rel"),
+    ("serve_p99_ms_p2", "lower", "rel"),
+    ("serve_fairness_ratio", "lower", "rel"),
+    ("serve_tenant_shed_rate_max", "lower", "rel"),
 )
 
-# serve-verdict field -> compare metric name
+# serve-verdict field -> compare metric name (flat v1 aggregates)
 _SERVE_METRIC_FIELDS: Tuple[Tuple[str, str], ...] = (
     ("p99_ms", "serve_p99_ms"),
     ("throughput_rps", "serve_throughput_rps"),
     ("shed_rate", "serve_shed_rate"),
 )
+
+# how many priority classes get their own compare metric (the default
+# class count of the serve-http front end; verdicts with fewer classes
+# simply leave the tail None)
+_SERVE_PRIORITY_CLASSES = 3
+
+
+def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten one serve verdict (v1 or v2) into the compare metric
+    namespace — shared by the run-dir and artifact extraction paths."""
+    out: Dict[str, Any] = {}
+    for field, name in _SERVE_METRIC_FIELDS:
+        out[name] = verdict.get(field)
+    per_priority = verdict.get("per_priority") or {}
+    for p in range(_SERVE_PRIORITY_CLASSES):
+        out[f"serve_p99_ms_p{p}"] = (
+            (per_priority.get(str(p)) or {}).get("p99_ms")
+        )
+    out["serve_fairness_ratio"] = verdict.get("fairness_ratio")
+    shed_rates = [
+        t.get("shed_rate")
+        for t in (verdict.get("per_tenant") or {}).values()
+        if t.get("shed_rate") is not None
+    ]
+    out["serve_tenant_shed_rate_max"] = (
+        max(shed_rates) if shed_rates else None
+    )
+    return out
 
 # the metric-key skeleton every extracted source carries (None = the
 # source does not know this metric; _judge skips it). time_to_common_acc
@@ -187,8 +230,7 @@ def _extract_run_dir(path: str) -> Dict[str, Any]:
 
     serve_verdict = serve_digest(events)["verdict"]
     if serve_verdict is not None:
-        for field, name in _SERVE_METRIC_FIELDS:
-            metrics[name] = serve_verdict.get(field)
+        metrics.update(_serve_metrics(serve_verdict))
     return {
         "source": path,
         "format": (
@@ -212,8 +254,7 @@ def _extract_artifact(path: str) -> Dict[str, Any]:
         # export provenance it embeds, judged on p99/throughput/shed
         prov = d.get("provenance") or {}
         metrics = dict(_EMPTY_METRICS)
-        for field, name in _SERVE_METRIC_FIELDS:
-            metrics[name] = d.get(field)
+        metrics.update(_serve_metrics(d))
         return {
             "source": path,
             "format": "serve_verdict",

@@ -61,7 +61,7 @@ Event kinds emitted by ``fit()``:
 - ``run_end``     — best acc/epoch, total wall seconds
 
 ``bench.py`` adds ``bench_result`` records with the same envelope. The
-serving subsystem (``bdbnn_tpu/serve/``) adds two more:
+serving subsystem (``bdbnn_tpu/serve/``) adds four more:
 
 - ``export``      — a training checkpoint was frozen into a serving
   artifact (serve/export.py): artifact path, arch, source checkpoint +
@@ -70,12 +70,31 @@ serving subsystem (``bdbnn_tpu/serve/``) adds two more:
   Appended to the SOURCE run's timeline, so the training→serving
   hand-off is auditable from the run dir alone
 - ``serve``       — serving telemetry from ``serve-bench``
-  (serve/loadgen.py), disambiguated by ``phase``: ``start`` (buckets,
-  per-bucket AOT warmup seconds, load model), ``stats`` (live queue
-  depth, batch occupancy, rolling p99, shed/completed counts — what
-  ``watch`` renders for a serving run), ``verdict`` (the final SLO
-  verdict: p50/p95/p99 ms, throughput, shed rate, drain disposition —
-  what ``compare`` judges across builds)
+  (serve/loadgen.py) and ``serve-http`` (serve/http.py),
+  disambiguated by ``phase``: ``start`` (buckets, per-bucket AOT
+  warmup seconds, load model), ``stats`` (live queue depth — plus
+  ``queue_depth_by_priority`` on serve-http runs — batch occupancy,
+  rolling p99, shed/completed counts — what ``watch`` renders for a
+  serving run), ``verdict`` (the final SLO verdict: p50/p95/p99 ms,
+  throughput, shed rate, drain disposition; v2 verdicts add
+  per-priority latency blocks, per-tenant shed rates and the
+  max/min fairness ratio — what ``compare`` judges across builds)
+- ``http``        — the network front end's lifecycle (serve/http.py),
+  disambiguated by ``phase``: ``start`` (bind host/port, priority
+  classes, per-class queue bound, scenario), ``ready`` (AOT warmup
+  finished — /readyz flipped 200; per-bucket compile seconds),
+  ``stats`` (periodic live state: readiness, in-flight count,
+  per-priority queue depths / completed / shed counts, per-tenant
+  admission counters — the serving heartbeat ``watch`` renders),
+  ``drain`` (the SIGTERM latch fired: signum, preempted flag —
+  /readyz went 503 while accepted requests finish), ``stop`` (the
+  listener closed after the verdict)
+- ``admission``   — per-tenant admission control (serve/admission.py):
+  ``config`` (the default token-bucket quota and every per-tenant
+  override, recorded at startup so a verdict's shed rates can be read
+  against the quotas that produced them), ``summary`` (final
+  per-tenant admitted / over-quota / queue-shed / completed counters
+  at drain — the per-tenant half of the SLO verdict)
 
 New kinds must be registered in :data:`KNOWN_KINDS` —
 ``tests/test_events_schema.py`` AST-scans every ``.emit(`` call site in
@@ -98,6 +117,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -124,6 +144,8 @@ KNOWN_KINDS = frozenset(
         "bench_result",
         "export",
         "serve",
+        "http",
+        "admission",
     }
 )
 
@@ -205,7 +227,12 @@ class EventWriter:
 
     ``emit`` is cheap host work (one json.dumps + buffered write +
     flush) — safe inside the hot loop's drain points, never between
-    async dispatches.
+    async dispatches. It is also thread-safe: the serving stack emits
+    concurrently from the micro-batcher worker (``on_batch``), the
+    serve-http stats pump and the main thread, and interleaved writes
+    would tear JSONL lines (silently dropped by the tolerant reader —
+    lost telemetry) or let two threads race ``_rotate`` into a closed
+    file. One lock around write+flush+rotate closes both.
 
     ``max_bytes`` > 0 enables size-aware rotation: when the live file
     crosses the cap after a write, it becomes the next ``events.<N>``
@@ -221,14 +248,17 @@ class EventWriter:
         os.makedirs(log_path, exist_ok=True)
         self.path = os.path.join(log_path, name)
         self.max_bytes = max(int(max_bytes), 0)
+        self._lock = threading.Lock()
         self._f = open(self.path, "a")
 
     def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
         rec = jsonsafe({"t": round(time.time(), 3), "kind": kind, **fields})
-        self._f.write(json.dumps(rec, default=repr) + "\n")
-        self._f.flush()
-        if self.max_bytes and self._f.tell() >= self.max_bytes:
-            self._rotate()
+        line = json.dumps(rec, default=repr) + "\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+            if self.max_bytes and self._f.tell() >= self.max_bytes:
+                self._rotate()
         return rec
 
     def _rotate(self) -> None:
@@ -246,8 +276,9 @@ class EventWriter:
 
     def close(self) -> None:
         """Idempotent: fit() closes on every exit path."""
-        if not self._f.closed:
-            self._f.close()
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
 
 
 def read_events(
@@ -272,12 +303,15 @@ load_events = read_events
 
 def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """One shared digest of a timeline's serving telemetry — the
-    ``export`` events plus the ``serve`` phases (``start`` marker, the
-    ``stats`` trail, the LAST ``verdict``). ``summarize``, ``watch``
-    and ``compare`` all consume serving runs through this, so a
-    verdict-field change lands in one place instead of three."""
+    ``export`` events, the ``serve`` phases (``start`` marker, the
+    ``stats`` trail, the LAST ``verdict``) and the network front end's
+    ``http``/``admission`` trail (serve-http runs). ``summarize``,
+    ``watch`` and ``compare`` all consume serving runs through this,
+    so a verdict-field change lands in one place instead of three."""
     exports = [e for e in events if e.get("kind") == "export"]
     serves = [e for e in events if e.get("kind") == "serve"]
+    https = [e for e in events if e.get("kind") == "http"]
+    admissions = [e for e in events if e.get("kind") == "admission"]
     return {
         "exports": exports,
         "start": next(
@@ -286,6 +320,24 @@ def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "stats": [e for e in serves if e.get("phase") == "stats"],
         "verdict": next(
             (e for e in reversed(serves) if e.get("phase") == "verdict"),
+            None,
+        ),
+        "http_start": next(
+            (e for e in https if e.get("phase") == "start"), None
+        ),
+        "http_stats": [e for e in https if e.get("phase") == "stats"],
+        "http_drain": next(
+            (e for e in reversed(https) if e.get("phase") == "drain"),
+            None,
+        ),
+        "admission_config": next(
+            (e for e in admissions if e.get("phase") == "config"), None
+        ),
+        "admission_summary": next(
+            (
+                e for e in reversed(admissions)
+                if e.get("phase") == "summary"
+            ),
             None,
         ),
     }

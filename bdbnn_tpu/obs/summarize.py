@@ -287,7 +287,11 @@ def _serving(events) -> Optional[Dict[str, Any]]:
     start = digest["start"]
     stats = digest["stats"]
     verdict = digest["verdict"]
-    if not exports and start is None and not stats and verdict is None:
+    http_start = digest["http_start"]
+    if (
+        not exports and start is None and not stats and verdict is None
+        and http_start is None
+    ):
         return None
     return {
         "exports": [
@@ -309,7 +313,26 @@ def _serving(events) -> Optional[Dict[str, Any]]:
             if start
             else None
         ),
-        "stats_events": len(stats),
+        "http": (
+            {
+                k: http_start.get(k)
+                for k in ("host", "port", "arch", "priorities",
+                          "queue_depth", "buckets", "scenario",
+                          "rate_rps", "requests")
+            }
+            if http_start
+            else None
+        ),
+        "admission": (
+            {
+                "tenants": (
+                    digest["admission_summary"].get("tenants") or {}
+                ),
+            }
+            if digest["admission_summary"]
+            else None
+        ),
+        "stats_events": len(stats) + len(digest["http_stats"]),
         "verdict": (
             {
                 k: verdict.get(k)
@@ -318,7 +341,8 @@ def _serving(events) -> Optional[Dict[str, Any]]:
                           "requests_submitted", "requests_completed",
                           "requests_shed", "max_queue_depth_seen",
                           "max_queue", "preempted", "drained_clean",
-                          "wall_s")
+                          "wall_s", "scenario", "per_priority",
+                          "per_tenant", "fairness_ratio", "slo")
             }
             if verdict
             else None
@@ -522,6 +546,21 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                 f"{bench.get('queue_depth')} | coalesce "
                 f"{bench.get('max_delay_ms')}ms"
             )
+        http = serving.get("http")
+        if http:
+            lines.append(
+                f"serving: http front end {http.get('host')}:"
+                f"{http.get('port')} on {http.get('arch')} | "
+                f"{http.get('priorities')} priority classes x queue "
+                f"{http.get('queue_depth')} | buckets "
+                f"{http.get('buckets')}"
+                + (
+                    f" | scenario {http.get('scenario')} @ "
+                    f"{http.get('rate_rps')} req/s"
+                    if http.get("scenario")
+                    else ""
+                )
+            )
         sv = serving.get("verdict")
         if sv:
             lines.append(
@@ -540,6 +579,49 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                 lines.append(
                     f"  queue: peak depth {sv.get('max_queue_depth_seen')}"
                     f" of bound {sv.get('max_queue')}"
+                )
+            # the per-priority latency table (v2 / serve-http verdicts)
+            per_priority = sv.get("per_priority") or {}
+            if per_priority:
+                lines.append(
+                    f"  {'class':<8} {'p50':>8} {'p95':>8} {'p99':>8} "
+                    f"{'ok':>7} {'shed':>6} {'of':>7}"
+                )
+                for p in sorted(per_priority, key=int):
+                    v = per_priority[p]
+
+                    def _ms(x):
+                        return "-" if x is None else f"{x:.1f}"
+
+                    lines.append(
+                        f"  p{p:<7} {_ms(v.get('p50_ms')):>8} "
+                        f"{_ms(v.get('p95_ms')):>8} "
+                        f"{_ms(v.get('p99_ms')):>8} "
+                        f"{v.get('completed'):>7} {v.get('shed'):>6} "
+                        f"{v.get('submitted'):>7}"
+                    )
+            per_tenant = sv.get("per_tenant") or {}
+            for t in sorted(per_tenant):
+                v = per_tenant[t]
+                lines.append(
+                    f"  tenant {t}: {v.get('completed')}/"
+                    f"{v.get('submitted')} ok | "
+                    f"{v.get('over_quota')} over-quota | "
+                    f"{v.get('shed_queue')} queue-shed "
+                    f"(shed rate {v.get('shed_rate')})"
+                )
+            if sv.get("fairness_ratio") is not None:
+                lines.append(
+                    "  fairness: max/min tenant service ratio "
+                    f"{sv.get('fairness_ratio')}"
+                )
+            slo = sv.get("slo")
+            if slo is not None:
+                lines.append(
+                    "  slo: priority-0 p99 "
+                    f"{slo.get('p99_ms_priority0')} ms vs target "
+                    f"{slo.get('p99_ms_target_priority0')} ms — "
+                    + ("MET" if slo.get("met") else "MISSED")
                 )
     if tta:
         lines.append("time-to-accuracy (val top-1):")

@@ -32,8 +32,11 @@ def _mean(vals: List[float]) -> Optional[float]:
 def _serve_lines(events) -> List[str]:
     """The serving view: when a timeline carries ``serve`` events (a
     ``serve-bench`` run dir) render live queue depth, batch occupancy,
-    rolling p99 and shed count; ``export`` events on a TRAINING run's
-    timeline get a one-line hand-off note."""
+    rolling p99 and shed count; a ``serve-http`` run dir additionally
+    gets the front end's readiness state, per-priority queue depths
+    and per-tenant shed counters (the ``http``/``admission`` trail);
+    ``export`` events on a TRAINING run's timeline get a one-line
+    hand-off note."""
     from bdbnn_tpu.obs.events import serve_digest
 
     digest = serve_digest(events)
@@ -48,11 +51,55 @@ def _serve_lines(events) -> List[str]:
     start = digest["start"]
     stats = digest["stats"]
     verdict = digest["verdict"]
+    http_start = digest["http_start"]
+    http_stats = digest["http_stats"]
+    http_drain = digest["http_drain"]
     if start:
         lines.append(
             f"serve: {start.get('mode')} load on {start.get('arch')} | "
             f"buckets {start.get('buckets')} | queue bound "
             f"{start.get('queue_depth')} | {start.get('requests')} requests"
+        )
+    if http_start:
+        lines.append(
+            f"http:  {http_start.get('host')}:{http_start.get('port')} "
+            f"on {http_start.get('arch')} | "
+            f"{http_start.get('priorities')} priority classes x queue "
+            f"{http_start.get('queue_depth')} | buckets "
+            f"{http_start.get('buckets')}"
+            + (
+                f" | scenario {http_start.get('scenario')}"
+                if http_start.get("scenario")
+                else ""
+            )
+        )
+    if http_stats and verdict is None:
+        s = http_stats[-1]
+        age = time.time() - float(s.get("t", time.time()))
+        state = s.get("state")
+        mark = {"ready": "READY", "warming": "WARMING",
+                "draining": "DRAINING"}.get(state, str(state))
+        lines.append(
+            f"state: {mark} | inflight {s.get('inflight')} | "
+            f"queues/prio {s.get('queue_depth_by_priority')} | "
+            f"done/prio {s.get('completed_by_priority')} | "
+            f"shed/prio {s.get('shed_by_priority')} | {age:.0f}s ago"
+        )
+        tenants = s.get("tenants") or {}
+        if tenants:
+            lines.append(
+                "tenants: "
+                + "  ".join(
+                    f"{t}: {c.get('admitted')} ok / "
+                    f"{c.get('over_quota')} over-quota / "
+                    f"{c.get('shed')} shed"
+                    for t, c in sorted(tenants.items())
+                )
+            )
+    if http_drain and verdict is None:
+        lines.append(
+            f"!! draining (signal {http_drain.get('signum')}) — "
+            "accepted requests finishing, readyz is 503"
         )
     if stats and verdict is None:
         s = stats[-1]
@@ -74,6 +121,17 @@ def _serve_lines(events) -> List[str]:
             f"{shed_rate:.1%}"
             + (" | PREEMPTED, drained" if verdict.get("preempted") else "")
         )
+        per_priority = verdict.get("per_priority") or {}
+        for p in sorted(per_priority, key=int):
+            v = per_priority[p]
+            lines.append(
+                f"  p{p}: p99 {v.get('p99_ms')} ms | "
+                f"{v.get('completed')}/{v.get('submitted')} ok | "
+                f"shed {v.get('shed')}"
+            )
+        fr = verdict.get("fairness_ratio")
+        if fr is not None:
+            lines.append(f"  fairness: max/min tenant service {fr}")
     return lines
 
 

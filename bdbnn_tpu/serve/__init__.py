@@ -13,18 +13,29 @@ Training's other half. Four modules, composing bottom-up:
   request queue with deadline coalescing, explicit load shedding, and
   latched-flag graceful drain (stdlib-only, engine injected)
 - :mod:`bdbnn_tpu.serve.loadgen`  — closed/open-loop (Poisson) load
-  generator producing the strict-JSON SLO verdict, plus the
-  ``serve-bench`` orchestration that wires everything to a run dir
-  (manifest + ``serve`` events) the obs/ tooling already understands
+  generator producing the strict-JSON SLO verdict, the ``serve-bench``
+  orchestration that wires everything to a run dir (manifest +
+  ``serve`` events) the obs/ tooling already understands, plus the
+  traffic-shaped arrival processes (diurnal / flash-crowd /
+  heavy-tail / slow-client) and the raw-socket HTTP load generator
+  that drives the network front end
+- :mod:`bdbnn_tpu.serve.admission` — per-tenant token-bucket quotas +
+  the admit / over-quota / draining decision taxonomy (stdlib-only)
+- :mod:`bdbnn_tpu.serve.http`     — the network front end: stdlib
+  asyncio HTTP/1.1 over the batcher with priority classes
+  (``x-priority`` header → per-class bounded queues), per-tenant
+  admission control (429 vs 503), /healthz + /readyz wired to AOT
+  warmup + the drain latch, and the ``serve-http`` orchestration
 
-CLI surface: ``export`` / ``predict`` / ``serve-bench``
-(``bdbnn_tpu.cli``). Import of this package root stays light — the
-modules lazy-import jax where they need it, so the batcher and verdict
-tooling work backend-free.
+CLI surface: ``export`` / ``predict`` / ``serve-bench`` /
+``serve-http`` (``bdbnn_tpu.cli``). Import of this package root stays
+light — the modules lazy-import jax where they need it, so the
+batcher, admission, HTTP and verdict tooling all work backend-free.
 """
 
 from __future__ import annotations
 
+from bdbnn_tpu.serve.admission import AdmissionController, TokenBucket
 from bdbnn_tpu.serve.batching import LoadShedError, MicroBatcher
 from bdbnn_tpu.serve.export import (
     ARTIFACT_NAME,
@@ -33,9 +44,13 @@ from bdbnn_tpu.serve.export import (
     load_artifact_variables,
     read_artifact,
 )
+from bdbnn_tpu.serve.http import HttpFrontEnd, run_serve_http
 from bdbnn_tpu.serve.loadgen import (
+    SCENARIOS,
     VERDICT_NAME,
+    HttpLoadGenerator,
     LoadGenerator,
+    build_schedule,
     percentile,
     run_serve_bench,
     slo_verdict,
@@ -43,15 +58,22 @@ from bdbnn_tpu.serve.loadgen import (
 
 __all__ = [
     "ARTIFACT_NAME",
+    "SCENARIOS",
     "VERDICT_NAME",
     "WEIGHTS_NAME",
+    "AdmissionController",
+    "HttpFrontEnd",
+    "HttpLoadGenerator",
     "LoadGenerator",
     "LoadShedError",
     "MicroBatcher",
+    "TokenBucket",
+    "build_schedule",
     "export_artifact",
     "load_artifact_variables",
     "percentile",
     "read_artifact",
     "run_serve_bench",
+    "run_serve_http",
     "slo_verdict",
 ]

@@ -1,24 +1,31 @@
-"""Dynamic micro-batching: coalesce requests, bound the queue, drain.
+"""Dynamic micro-batching: coalesce requests, bound the queues, drain.
 
 Serving individual requests through a batched accelerator engine wants
-three properties the naive loop lacks:
+four properties the naive loop lacks:
 
 1. **Coalescing under a deadline** — single requests are batched up to
    the engine's largest bucket, but never held past ``max_delay_ms``
    from the first request's enqueue: throughput from batching, with a
    hard cap on the latency it can add.
-2. **Bounded queue + load shedding** — the request queue has a fixed
-   capacity; when it is full, ``submit`` raises :class:`LoadShedError`
-   IMMEDIATELY (explicit rejection the client can retry against)
-   instead of growing without bound until the process dies far from the
-   overload that caused it.
-3. **Graceful drain** — ``drain()`` latches a flag (the same
+2. **Bounded queues + load shedding** — every request queue has a fixed
+   capacity; when a priority's queue is full, ``submit`` raises
+   :class:`LoadShedError` IMMEDIATELY (explicit rejection the client
+   can retry against) instead of growing without bound until the
+   process dies far from the overload that caused it.
+3. **Priority classes + strict-priority dequeue** — requests carry a
+   priority (0 = most important); each class gets its OWN bounded
+   queue, and the worker always drains the highest class first when
+   assembling a batch. Under overload the low classes shed while the
+   high class keeps its latency: per-class isolation on the queue
+   bound, per-batch preference on the dequeue. The HTTP front end
+   (serve/http.py) maps the ``x-priority`` request header onto this.
+4. **Graceful drain** — ``drain()`` latches a flag (the same
    latched-flag pattern as ``train/resilience.py``'s
    ``PreemptionHandler``: the signal moment only sets state; the worker
    loop observes it at a safe boundary), after which new submits are
    shed but every request already accepted is answered before the
    worker exits. SIGTERM → ``drain()`` is wired by the ``serve-bench``
-   CLI through a ``PreemptionHandler``.
+   and ``serve-http`` CLIs through a ``PreemptionHandler``.
 
 Stdlib-only: the engine is injected as a callable, so the batcher (and
 its tests) never need a JAX backend.
@@ -26,9 +33,9 @@ its tests) never need a JAX backend.
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
 
@@ -42,10 +49,11 @@ class LoadShedError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("payload", "future", "t_enqueue")
+    __slots__ = ("payload", "priority", "future", "t_enqueue")
 
-    def __init__(self, payload):
+    def __init__(self, payload, priority: int = 0):
         self.payload = payload
+        self.priority = priority
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
 
@@ -56,7 +64,14 @@ class MicroBatcher:
     ``runner(batch_list) -> results`` receives the payloads of one
     coalesced batch and returns one result per payload (any indexable).
     ``on_batch(stats_dict)`` (optional) fires after every executed
-    batch — the serve-bench CLI uses it to emit ``serve`` events.
+    batch — the serve CLIs use it to emit ``serve`` events.
+
+    ``priorities`` (default 1) sets the number of priority classes;
+    ``submit(payload, priority=p)`` with ``0 <= p < priorities``
+    enqueues into class p's own bounded queue (bound = ``max_queue``
+    PER class). ``stats()["per_priority"]`` is the one source of truth
+    for per-class occupancy — the HTTP stats endpoint, the live
+    ``watch`` events and the SLO verdict all read it.
     """
 
     def __init__(
@@ -67,21 +82,28 @@ class MicroBatcher:
         max_queue: int = 128,
         max_delay_ms: float = 5.0,
         on_batch: Optional[Callable[[Dict[str, Any]], None]] = None,
+        priorities: int = 1,
     ):
         if max_batch <= 0 or max_queue <= 0:
             raise ValueError("max_batch and max_queue must be positive")
+        if priorities <= 0:
+            raise ValueError("priorities must be >= 1")
         self.runner = runner
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.on_batch = on_batch
-        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+        self.priorities = int(priorities)
+        # one bounded deque per priority class, 0 drained first; all
+        # guarded by _lock (the Condition's lock)
+        self._qs: List[deque] = [deque() for _ in range(self.priorities)]
         # latched drain flag (resilience.py pattern): set once, observed
         # by the worker at batch boundaries and by submit immediately
         self._draining = threading.Event()
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         # set by the WORKER, under _lock, after its final queue sweep:
-        # once True no request can enter the queue, so no accepted
+        # once True no request can enter a queue, so no accepted
         # Future can ever be left unresolved (see _worker/submit)
         self._dead = False
         self.shed = 0
@@ -89,6 +111,11 @@ class MicroBatcher:
         self.batches = 0
         self.occupancy_sum = 0.0
         self.max_queue_depth_seen = 0
+        # per-priority counters, index = priority class
+        self._shed_p = [0] * self.priorities
+        self._completed_p = [0] * self.priorities
+        self._max_depth_p = [0] * self.priorities
+        self._occupancy_sum_p = [0.0] * self.priorities
         self._thread = threading.Thread(
             target=self._worker, name="micro-batcher", daemon=True
         )
@@ -96,29 +123,41 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, payload) -> Future:
-        """Enqueue one request; returns its Future. Raises
-        :class:`LoadShedError` when draining or the queue is full —
-        never blocks the caller on a full queue.
+    def submit(self, payload, priority: int = 0) -> Future:
+        """Enqueue one request into its priority class; returns its
+        Future. Raises :class:`LoadShedError` when draining or that
+        class's queue is full — never blocks the caller on a full
+        queue; raises ``ValueError`` on an out-of-range priority (a
+        malformed header must be rejected by the CALLER with a 400,
+        not silently reclassified here).
 
         The enqueue happens under ``_lock``, the same lock the worker's
         drain-exit holds for its final queue sweep + ``_dead`` latch: a
         request either lands before that sweep (and is answered or
         explicitly failed by it) or observes ``_dead`` and is shed here
         — an accepted Future can never be left unresolved."""
-        req = _Request(payload)
-        with self._lock:
+        p = int(priority)
+        if not 0 <= p < self.priorities:
+            raise ValueError(
+                f"priority must be in [0, {self.priorities}), got {p}"
+            )
+        req = _Request(payload, p)
+        with self._cv:
             if self._dead or self._draining.is_set():
                 self.shed += 1
+                self._shed_p[p] += 1
                 raise LoadShedError("draining")
-            try:
-                self._q.put_nowait(req)
-            except queue.Full:
+            if len(self._qs[p]) >= self.max_queue:
                 self.shed += 1
-                raise LoadShedError("queue full") from None
+                self._shed_p[p] += 1
+                raise LoadShedError("queue full")
+            self._qs[p].append(req)
+            depth = len(self._qs[p])
+            self._max_depth_p[p] = max(self._max_depth_p[p], depth)
             self.max_queue_depth_seen = max(
-                self.max_queue_depth_seen, self._q.qsize()
+                self.max_queue_depth_seen, sum(len(q) for q in self._qs)
             )
+            self._cv.notify()
         return req.future
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -130,6 +169,8 @@ class MicroBatcher:
         exit protocol (final queue sweep + ``_dead`` latch under the
         submit lock, see :meth:`_worker`), not by timing here."""
         self._draining.set()
+        with self._cv:
+            self._cv.notify_all()  # wake a worker parked on an empty queue
         self._thread.join(timeout)
         return not self._thread.is_alive()
 
@@ -139,46 +180,77 @@ class MicroBatcher:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            batches = max(self.batches, 1)
             return {
                 "completed": self.completed,
                 "shed": self.shed,
                 "batches": self.batches,
-                "mean_occupancy": round(
-                    self.occupancy_sum / max(self.batches, 1), 4
-                ),
-                "queue_depth": self._q.qsize(),
+                "mean_occupancy": round(self.occupancy_sum / batches, 4),
+                "queue_depth": sum(len(q) for q in self._qs),
                 "max_queue_depth_seen": self.max_queue_depth_seen,
-                "max_queue": self.max_queue,
+                # the AGGREGATE capacity the aggregate depth is bounded
+                # by (per-class bound x classes) — `peak depth N of
+                # bound M` must be a coherent pair in every consumer;
+                # the per-class bound rides alongside
+                "max_queue": self.max_queue * self.priorities,
+                "max_queue_per_class": self.max_queue,
+                "priorities": self.priorities,
+                # the one per-class source of truth: verdict + watch +
+                # /statsz all read this, never private counters
+                "per_priority": [
+                    {
+                        "priority": p,
+                        "queue_depth": len(self._qs[p]),
+                        "max_queue_depth_seen": self._max_depth_p[p],
+                        "completed": self._completed_p[p],
+                        "shed": self._shed_p[p],
+                        "mean_occupancy": round(
+                            self._occupancy_sum_p[p] / batches, 4
+                        ),
+                    }
+                    for p in range(self.priorities)
+                ],
             }
 
     # -- worker side ---------------------------------------------------
 
+    def _pop_highest(self) -> Optional[_Request]:
+        """Pop the oldest request of the HIGHEST nonempty class (strict
+        priority: class 1 is only served when class 0 is empty). Caller
+        holds ``_lock``."""
+        for q in self._qs:
+            if q:
+                return q.popleft()
+        return None
+
     def _collect(self) -> List[_Request]:
         """One coalesced batch: block for the first request (waking to
-        re-check the drain flag), then gather until the batch is full or
-        the first request's deadline passes."""
-        while True:
-            try:
-                first = self._q.get(timeout=0.02)
-                break
-            except queue.Empty:
+        re-check the drain flag), then gather — highest priority first —
+        until the batch is full or the first request's deadline passes."""
+        with self._cv:
+            while True:
+                first = self._pop_highest()
+                if first is not None:
+                    break
                 if self._draining.is_set():
                     return []
+                self._cv.wait(timeout=0.02)
         batch = [first]
         deadline = first.t_enqueue + self.max_delay_s
         while len(batch) < self.max_batch:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                # deadline passed: take whatever is already queued, but
-                # wait no further
-                try:
-                    batch.append(self._q.get_nowait())
-                except queue.Empty:
-                    break
-                continue
-            try:
-                batch.append(self._q.get(timeout=remaining))
-            except queue.Empty:
+            with self._cv:
+                nxt = self._pop_highest()
+                if nxt is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._draining.is_set():
+                        # deadline passed (or draining: flush what we
+                        # have — latency over occupancy on the way out)
+                        break
+                    self._cv.wait(timeout=remaining)
+                    nxt = self._pop_highest()
+            if nxt is not None:
+                batch.append(nxt)
+            elif time.monotonic() >= deadline or self._draining.is_set():
                 break
         return batch
 
@@ -191,14 +263,14 @@ class MicroBatcher:
                 # landed before this sweep (failed here, explicitly) or
                 # its submit observes _dead and sheds. Futures are
                 # resolved outside the lock; nothing else touches them.
-                with self._lock:
+                with self._cv:
                     stragglers = []
-                    while True:
-                        try:
-                            stragglers.append(self._q.get_nowait())
-                        except queue.Empty:
-                            break
+                    for q in self._qs:
+                        while q:
+                            stragglers.append(q.popleft())
                     self.shed += len(stragglers)
+                    for req in stragglers:
+                        self._shed_p[req.priority] += 1
                     self._dead = True
                 for req in stragglers:
                     if not req.future.done():
@@ -224,14 +296,26 @@ class MicroBatcher:
                 except Exception as e:
                     if not r.future.done():
                         r.future.set_exception(e)
-            with self._lock:
+            with self._cv:
+                per_prio_n = [0] * self.priorities
+                for r in batch:
+                    per_prio_n[r.priority] += 1
                 self.completed += len(batch)
                 self.batches += 1
                 self.occupancy_sum += len(batch) / self.max_batch
+                for p in range(self.priorities):
+                    self._completed_p[p] += per_prio_n[p]
+                    self._occupancy_sum_p[p] += (
+                        per_prio_n[p] / self.max_batch
+                    )
                 stats = {
                     "batch_size": len(batch),
                     "occupancy": round(len(batch) / self.max_batch, 4),
-                    "queue_depth": self._q.qsize(),
+                    "queue_depth": sum(len(q) for q in self._qs),
+                    "queue_depth_by_priority": [
+                        len(q) for q in self._qs
+                    ],
+                    "batch_by_priority": per_prio_n,
                     "run_ms": round((t1 - t0) * 1000.0, 3),
                     "oldest_wait_ms": round(
                         (t0 - batch[0].t_enqueue) * 1000.0, 3

@@ -79,6 +79,13 @@ class InferenceEngine:
 
     # -- compilation ---------------------------------------------------
 
+    @property
+    def warmed(self) -> bool:
+        """True once EVERY bucket is AOT-compiled — what the HTTP front
+        end's ``/readyz`` gates on (serve/http.py): a replica must not
+        receive traffic that would stall on a first-request compile."""
+        return all(b in self._compiled for b in self.buckets)
+
     def _apply(self, variables, images):
         return self._model.apply(variables, images, train=False)
 

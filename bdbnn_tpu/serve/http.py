@@ -1,0 +1,889 @@
+"""Network front end: stdlib asyncio HTTP/1.1 over the serving stack.
+
+Until now the serving stack spoke in-process Python calls — no sockets,
+no tenants, no request priorities. This module puts a real (if
+deliberately minimal) HTTP/1.1 server in front of the
+``MicroBatcher`` → AOT engine path, keeping the zero-dependency stance:
+``asyncio.start_server`` + a hand-rolled request parser, no aiohttp.
+
+Endpoints:
+
+==================  ====================================================
+``POST /v1/predict``  one inference request. Headers: ``x-priority``
+                      (int class, 0 = most important; out-of-range →
+                      400) and ``x-tenant`` (quota key; default
+                      ``anon``). Body: the payload ``decode`` accepts
+                      (the CLI wires raw float32 image bytes or a JSON
+                      list). 200 + logits JSON, or an explicit shed:
+                      **429** ``over_quota`` (THIS tenant's bucket is
+                      empty — its fault, retry later) vs **503**
+                      ``draining`` / ``queue full`` (the SERVER is
+                      going away or overloaded — retry elsewhere);
+                      both carry ``retry-after``.
+``GET /healthz``      liveness: 200 as soon as the process serves
+                      sockets (load balancer: don't kill me).
+``GET /readyz``       readiness: 200 only when the engine's AOT warmup
+                      has finished AND the drain latch is clear
+                      (load balancer: you may route to me). SIGTERM →
+                      flips to 503 ``draining`` BEFORE in-flight
+                      requests finish — new traffic moves away while
+                      accepted requests are answered.
+``GET /statsz``       live stats JSON: per-priority queue occupancy
+                      (one source of truth: ``MicroBatcher.stats()``),
+                      per-tenant admission counters, in-flight count,
+                      readiness state.
+==================  ====================================================
+
+**Drain contract (the PR 5 semantics extended over sockets).** SIGTERM
+latches: ``/readyz`` goes 503 immediately, ``admit()`` starts
+returning ``draining`` (503), and every request ALREADY accepted is
+answered before the server closes — ``drain()`` waits for the
+in-flight count to reach zero, then drains the batcher (whose queues
+empty into answered futures, never dropped ones), then closes the
+listener. An accepted request is never dropped; the verdict is written
+after the last response.
+
+The engine is injected as the batcher's runner callable, so this
+module (and its socket tests) never needs a JAX backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from bdbnn_tpu.obs.events import jsonsafe
+from bdbnn_tpu.serve.admission import (
+    ADMIT,
+    DEFAULT_TENANT,
+    DRAINING,
+    OVER_QUOTA,
+    AdmissionController,
+)
+from bdbnn_tpu.serve.batching import LoadShedError, MicroBatcher
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    413: "Payload Too Large",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+PREDICT_PATH = "/v1/predict"
+
+
+def _default_decode(body: bytes, content_type: str) -> Any:
+    return json.loads(body) if body else None
+
+
+def _default_encode(result: Any) -> Any:
+    return jsonsafe(result)
+
+
+class HttpFrontEnd:
+    """The asyncio server, run on its own thread so synchronous callers
+    (CLI main loop, tests, the thread-based load generator) can drive
+    it with plain calls: ``start()`` → (host, port), ``drain()``,
+    ``stats()``, ``accounting()``.
+
+    ``ready_fn`` reports the engine's AOT warmup state (``/readyz``
+    gates on it); ``decode``/``encode`` translate HTTP bodies to/from
+    batcher payloads, so the server itself stays numpy-free.
+    """
+
+    def __init__(
+        self,
+        batcher: MicroBatcher,
+        admission: AdmissionController,
+        *,
+        ready_fn: Callable[[], bool] = lambda: True,
+        decode: Callable[[bytes, str], Any] = _default_decode,
+        encode: Callable[[Any], Any] = _default_encode,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_body_bytes: int = 16 * 2**20,
+        default_priority: Optional[int] = None,
+        retry_after_s: int = 1,
+    ):
+        self.batcher = batcher
+        self.admission = admission
+        self.ready_fn = ready_fn
+        self.decode = decode
+        self.encode = encode
+        self.host = host
+        self.port = int(port)
+        self.max_body_bytes = int(max_body_bytes)
+        # an absent x-priority header lands in the LOWEST class: best
+        # effort by default, priority is something a client asks for
+        self.default_priority = (
+            batcher.priorities - 1
+            if default_priority is None
+            else int(default_priority)
+        )
+        self.retry_after_s = int(retry_after_s)
+        self._draining = threading.Event()
+        # in-flight = /v1/predict handlers between request-parsed and
+        # response-written; open connections additionally tracked in
+        # _conns so drain can give still-reading (e.g. slow-dribble)
+        # clients a grace to finish and collect their 503
+        self._inflight = 0
+        self._conns = 0
+        self._inflight_cv = threading.Condition()
+        # accounting (mutated only on the loop thread; snapshotted from
+        # others — int/list appends are atomic enough under the GIL)
+        self._lat_by_priority: List[List[float]] = [
+            [] for _ in range(batcher.priorities)
+        ]
+        self._counts_by_priority: List[Dict[str, int]] = [
+            {"submitted": 0, "completed": 0, "failed": 0,
+             "rejected": 0, "shed_draining": 0, "shed_over_quota": 0,
+             "shed_queue_full": 0}
+            for _ in range(batcher.priorities)
+        ]
+        self._requests_seen = 0
+        self._t_started: Optional[float] = None
+        self._t_drained: Optional[float] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._start_error: Optional[BaseException] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, timeout: float = 10.0) -> Tuple[str, int]:
+        """Bind + serve on a dedicated event-loop thread; returns the
+        bound (host, port) — port 0 resolves to the kernel's pick."""
+        self._thread = threading.Thread(
+            target=self._serve_thread, name="http-front-end", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout):
+            raise RuntimeError("HTTP front end failed to start in time")
+        if self._start_error is not None:
+            raise RuntimeError(
+                f"HTTP front end failed to bind: {self._start_error}"
+            )
+        return self.host, self.port
+
+    def _serve_thread(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def _main():
+            try:
+                self._server = await asyncio.start_server(
+                    self._client, self.host, self.port
+                )
+            except OSError as e:
+                self._start_error = e
+                self._started.set()
+                return
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with self._server:
+                await self._server.serve_forever()
+
+        try:
+            loop.run_until_complete(_main())
+        except asyncio.CancelledError:
+            pass
+        finally:
+            # let pending handler callbacks (already-scheduled 503s)
+            # settle before tearing the loop down
+            try:
+                pending = [
+                    t for t in asyncio.all_tasks(loop)
+                    if t is not asyncio.current_task(loop)
+                ]
+                for t in pending:
+                    t.cancel()
+                if pending:
+                    loop.run_until_complete(
+                        asyncio.gather(*pending, return_exceptions=True)
+                    )
+            except Exception:
+                pass
+            loop.close()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout: float = 120.0) -> bool:
+        """The SIGTERM path, callable from any thread. Latches the
+        drain flag (readyz flips 503, new requests shed), waits for
+        every ACCEPTED request's response to be written, drains the
+        batcher, then closes the listener. Returns True when everything
+        wound down inside ``timeout``. Idempotent."""
+        already = self._draining.is_set()
+        self._draining.set()
+        self.admission.drain()
+        deadline = time.monotonic() + timeout
+        # 1. every accepted request answered (the socket-level extension
+        #    of the batcher's no-unresolved-Future guarantee)
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._inflight_cv.wait(remaining)
+            clean = self._inflight == 0
+        # 2. the batcher's own drain (queues empty by now: nothing new
+        #    could enter after the latch)
+        clean = self.batcher.drain(
+            timeout=max(deadline - time.monotonic(), 0.1)
+        ) and clean
+        if self._t_drained is None:
+            self._t_drained = time.perf_counter()
+        # 2b. grace for connections still mid-request — a slow client
+        #     dribbling its body is parked in readexactly and not yet
+        #     in-flight; give it a moment to finish the read and
+        #     collect its explicit 503 instead of a torn connection
+        #     (handlers close their connection at the next boundary
+        #     once the latch is set, so this converges fast)
+        grace_deadline = min(time.monotonic() + 2.0, deadline)
+        with self._inflight_cv:
+            while self._conns > 0:
+                remaining = grace_deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._inflight_cv.wait(remaining)
+        # 3. stop serving sockets and wind the loop down
+        if not already and self._loop is not None:
+            loop = self._loop
+
+            def _shutdown():
+                if self._server is not None:
+                    self._server.close()
+                # cancel serve_forever -> run_until_complete returns
+                for task in asyncio.all_tasks(loop):
+                    task.cancel()
+
+            try:
+                loop.call_soon_threadsafe(_shutdown)
+            except RuntimeError:
+                pass  # loop already closed
+        if self._thread is not None:
+            self._thread.join(max(deadline - time.monotonic(), 0.1))
+            clean = clean and not self._thread.is_alive()
+        return clean
+
+    # -- request plumbing ----------------------------------------------
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) != 3:
+            raise ValueError(f"malformed request line: {line!r}")
+        method, path, _version = parts
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        n = int(headers.get("content-length", 0) or 0)
+        if n > self.max_body_bytes:
+            return method, path, headers, None  # signals 413
+        body = await reader.readexactly(n) if n else b""
+        return method, path, headers, body
+
+    def _respond(
+        self, writer, status: int, obj: Any, *,
+        retry_after: bool = False, close: bool = False,
+    ) -> None:
+        body = json.dumps(jsonsafe(obj)).encode()
+        head = (
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
+            f"content-type: application/json\r\n"
+            f"content-length: {len(body)}\r\n"
+        )
+        if retry_after:
+            head += f"retry-after: {self.retry_after_s}\r\n"
+        if close:
+            head += "connection: close\r\n"
+        writer.write(head.encode("latin-1") + b"\r\n" + body)
+
+    async def _client(self, reader, writer) -> None:
+        with self._inflight_cv:
+            self._conns += 1
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (
+                    asyncio.IncompleteReadError, ValueError,
+                    ConnectionError,
+                ):
+                    break
+                if req is None:
+                    break
+                method, path, headers, body = req
+                close = (
+                    headers.get("connection", "").lower() == "close"
+                )
+                if body is None:
+                    self._respond(
+                        writer, 413, {"error": "payload too large"},
+                        close=True,
+                    )
+                    break
+                await self._route(writer, method, path, headers, body)
+                await writer.drain()
+                if close or self._draining.is_set():
+                    # draining: close at the request boundary so the
+                    # drain grace converges instead of waiting out
+                    # idle keep-alive connections
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # client went away mid-exchange: nothing to answer
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+            with self._inflight_cv:
+                self._conns -= 1
+                self._inflight_cv.notify_all()
+
+    async def _route(self, writer, method, path, headers, body) -> None:
+        if method == "GET" and path == "/healthz":
+            self._respond(writer, 200, {
+                "status": "ok",
+                "ready": bool(self.ready_fn()) and not self.draining,
+            })
+        elif method == "GET" and path == "/readyz":
+            if self.draining:
+                self._respond(
+                    writer, 503, {"state": "draining"}, retry_after=True
+                )
+            elif not self.ready_fn():
+                self._respond(
+                    writer, 503, {"state": "warming"}, retry_after=True
+                )
+            else:
+                self._respond(writer, 200, {"state": "ready"})
+        elif method == "GET" and path == "/statsz":
+            self._respond(writer, 200, self.stats())
+        elif method == "POST" and path == PREDICT_PATH:
+            await self._predict(writer, headers, body)
+        else:
+            self._respond(
+                writer, 404, {"error": f"no route {method} {path}"}
+            )
+
+    async def _predict(self, writer, headers, body) -> None:
+        t0 = time.perf_counter()
+        if self._t_started is None:
+            # the verdict's wall clock starts at the FIRST request, not
+            # at socket bind: AOT warmup (seconds on CPU, minutes on a
+            # real chip) and pre-load idle must not dilute
+            # throughput_rps, or compare would flag compile-time
+            # variance as a serving regression
+            self._t_started = t0
+        self._requests_seen += 1
+        tenant = headers.get("x-tenant") or DEFAULT_TENANT
+        raw_p = headers.get("x-priority")
+        if raw_p is None:
+            priority = self.default_priority
+        else:
+            try:
+                priority = int(raw_p)
+            except ValueError:
+                priority = -1
+            if not 0 <= priority < self.batcher.priorities:
+                self._respond(writer, 400, {
+                    "error": "bad x-priority",
+                    "want": f"int in [0, {self.batcher.priorities})",
+                    "got": raw_p,
+                })
+                return
+        # in-flight covers the WHOLE predict — admission through the
+        # written response — so drain's inflight-zero wait cannot race
+        # a request between submit and accounting
+        with self._inflight_cv:
+            self._inflight += 1
+        try:
+            await self._predict_body(
+                writer, headers, body, t0, tenant, priority
+            )
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    async def _predict_body(
+        self, writer, headers, body, t0, tenant: str, priority: int
+    ) -> None:
+        counts = self._counts_by_priority[priority]
+        counts["submitted"] += 1
+        decision = self.admission.admit(tenant)
+        if decision == DRAINING:
+            counts["shed_draining"] += 1
+            self._respond(
+                writer, 503,
+                {"error": "draining", "tenant": tenant},
+                retry_after=True,
+            )
+            return
+        if decision == OVER_QUOTA:
+            counts["shed_over_quota"] += 1
+            self._respond(
+                writer, 429,
+                {"error": "over_quota", "tenant": tenant},
+                retry_after=True,
+            )
+            return
+        assert decision == ADMIT
+        try:
+            payload = self.decode(
+                body, headers.get("content-type", "")
+            )
+        except Exception as e:
+            # a malformed body is neither completed nor shed — its own
+            # ledger column, so `completed + shed + failed + rejected
+            # == submitted` survives bad clients
+            counts["rejected"] += 1
+            self.admission.record_rejected(tenant)
+            self._respond(
+                writer, 400, {"error": f"undecodable body: {e}"}
+            )
+            return
+        try:
+            fut = self.batcher.submit(payload, priority=priority)
+        except LoadShedError as e:
+            self.admission.record_shed(tenant)
+            key = (
+                "shed_draining" if e.reason == "draining"
+                else "shed_queue_full"
+            )
+            counts[key] += 1
+            self._respond(
+                writer, 503,
+                {"error": e.reason, "tenant": tenant},
+                retry_after=True,
+            )
+            return
+        try:
+            result = await asyncio.wrap_future(fut)
+        except LoadShedError as e:
+            # a drain latched between submit and execution can in
+            # principle never strand a queued request (drain waits
+            # for in-flight first) — but belt and braces: it is
+            # still an explicit shed, never a dropped connection
+            self.admission.record_shed(tenant)
+            counts["shed_draining"] += 1
+            self._respond(
+                writer, 503,
+                {"error": e.reason, "tenant": tenant},
+                retry_after=True,
+            )
+            return
+        except Exception as e:
+            self.admission.record_failed(tenant)
+            counts["failed"] += 1
+            self._respond(
+                writer, 500, {"error": f"inference failed: {e}"}
+            )
+            return
+        lat_ms = (time.perf_counter() - t0) * 1000.0
+        self._lat_by_priority[priority].append(lat_ms)
+        counts["completed"] += 1
+        self.admission.record_completed(tenant)
+        self._respond(writer, 200, {
+            "result": self.encode(result),
+            "priority": priority,
+            "tenant": tenant,
+            "latency_ms": round(lat_ms, 3),
+        })
+        await writer.drain()
+
+    # -- reporting -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """The live snapshot ``/statsz`` serves and the periodic
+        ``http`` stats events carry: readiness, in-flight, per-priority
+        queue occupancy (straight from ``MicroBatcher.stats()`` — one
+        source of truth) and per-tenant admission counters."""
+        ready = bool(self.ready_fn()) and not self.draining
+        with self._inflight_cv:
+            inflight = self._inflight
+        return jsonsafe({
+            "ready": ready,
+            "state": (
+                "draining" if self.draining
+                else "ready" if ready else "warming"
+            ),
+            "inflight": inflight,
+            "requests_seen": self._requests_seen,
+            "batcher": self.batcher.stats(),
+            "admission": self.admission.stats(),
+            "completed_by_priority": [
+                c["completed"] for c in self._counts_by_priority
+            ],
+            "shed_by_priority": [
+                c["shed_draining"] + c["shed_over_quota"]
+                + c["shed_queue_full"]
+                for c in self._counts_by_priority
+            ],
+        })
+
+    def accounting(self) -> Dict[str, Any]:
+        """The post-drain request ledger the SLO verdict is built from:
+        per-priority latency samples + disposition counts, wall time."""
+        t_end = self._t_drained or time.perf_counter()
+        wall_s = (
+            t_end - self._t_started if self._t_started is not None else 0.0
+        )
+        return {
+            "wall_s": wall_s,
+            "latencies_ms_by_priority": [
+                sorted(l) for l in self._lat_by_priority
+            ],
+            "counts_by_priority": [
+                dict(c) for c in self._counts_by_priority
+            ],
+            "requests_seen": self._requests_seen,
+        }
+
+
+# ---------------------------------------------------------------------------
+# serve-http orchestration (the CLI body)
+# ---------------------------------------------------------------------------
+
+
+def run_serve_http(cfg) -> Dict[str, Any]:
+    """End-to-end HTTP serving over an export artifact (the
+    ``serve-http`` CLI body). ``cfg`` is a
+    :class:`bdbnn_tpu.configs.config.ServeHttpConfig`.
+
+    Two modes sharing one server lifecycle:
+
+    - ``cfg.scenario == ""`` — **serve**: bind, warm up, answer until
+      SIGTERM/SIGINT latches, then drain and write the verdict from
+      the server-side ledger.
+    - ``cfg.scenario`` set — **bench**: same server, plus the
+      scenario's socket load generator (serve/loadgen.py) driving real
+      HTTP against it; the verdict additionally carries the client's
+      own observation (the zero-dropped cross-check).
+
+    Either way the run dir carries the same manifest/events/verdict
+    artifacts as ``serve-bench``, so ``watch``/``summarize``/
+    ``compare`` consume it unchanged."""
+    from bdbnn_tpu.train.resilience import PreemptionHandler
+
+    cfg = cfg.validate()
+    # the SIGTERM latch covers the WHOLE run — a preemption during the
+    # multi-second AOT warmup must drain-and-report, not die with the
+    # default disposition
+    with PreemptionHandler() as handler:
+        return _serve_http_body(cfg, handler)
+
+
+def _serve_http_body(cfg, handler) -> Dict[str, Any]:
+    import datetime
+
+    import numpy as np
+
+    from bdbnn_tpu.obs.events import EventWriter
+    from bdbnn_tpu.obs.manifest import write_manifest
+    from bdbnn_tpu.serve.admission import parse_quota, parse_tenant_quotas
+    from bdbnn_tpu.serve.engine import InferenceEngine
+    from bdbnn_tpu.serve.loadgen import (
+        VERDICT_NAME,
+        HttpLoadGenerator,
+        _pct,
+        build_schedule,
+        http_slo_verdict,
+    )
+
+    # engine cold: the server comes up immediately with /healthz 200 +
+    # /readyz 503 "warming", flipping ready only when the AOT buckets
+    # are compiled — the load balancer sees the real warmup state
+    engine = InferenceEngine(cfg.artifact, buckets=cfg.buckets, warm=False)
+
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+    run_dir = os.path.join(cfg.log_path, stamp)
+    os.makedirs(run_dir, exist_ok=True)
+    prov = engine.artifact.get("provenance", {})
+    recipe = prov.get("recipe") or {}
+    manifest = write_manifest(
+        run_dir,
+        {
+            "mode": "serve-http",
+            "artifact": os.path.abspath(cfg.artifact),
+            # recipe fields flow through so `compare` aligns serving
+            # runs on the same export provenance (None entries dropped,
+            # spread FIRST — see serve-bench)
+            **{k: v for k, v in recipe.items() if v is not None},
+            "arch": engine.arch,
+            "dataset": engine.dataset,
+            "export_config_hash": prov.get("config_hash"),
+            "buckets": list(cfg.buckets),
+            "priorities": cfg.priorities,
+            "queue_depth": cfg.queue_depth,
+            "max_delay_ms": cfg.max_delay_ms,
+            "scenario": cfg.scenario or None,
+            "rate": cfg.rate,
+            "requests": cfg.requests,
+            "seed": cfg.seed,
+            "default_quota": cfg.default_quota,
+            "tenant_quotas": list(cfg.tenant_quotas),
+        },
+    )
+    events = EventWriter(run_dir, max_bytes=int(cfg.events_max_mb * 2**20))
+
+    default_rate, default_burst = parse_quota(cfg.default_quota)
+    admission = AdmissionController(
+        default_rate=default_rate,
+        default_burst=default_burst,
+        quotas=parse_tenant_quotas(cfg.tenant_quotas),
+    )
+    events.emit(
+        "admission",
+        phase="config",
+        default_rate=default_rate,
+        default_burst=default_burst,
+        tenant_quotas={
+            t: {"rate": r, "burst": b}
+            for t, (r, b) in parse_tenant_quotas(cfg.tenant_quotas).items()
+        },
+    )
+
+    # rolling p99 over a sliding latency window for the live `serve`
+    # stats events `watch` renders (same shape as serve-bench, plus
+    # the per-priority queue depths)
+    window: List[float] = []
+    win_lock = threading.Lock()
+    batch_counter = [0]
+    emit_every = max(
+        cfg.requests // (20 * max(engine.buckets[-1], 1)), 1
+    )
+
+    def on_batch(stats: Dict[str, Any]) -> None:
+        with win_lock:
+            window.append(stats["oldest_wait_ms"] + stats["run_ms"])
+            del window[:-256]
+            rolling = sorted(window)
+            batch_counter[0] += 1
+            n = batch_counter[0]
+        if n % emit_every == 0:
+            events.emit(
+                "serve",
+                phase="stats",
+                batch_size=stats["batch_size"],
+                occupancy=stats["occupancy"],
+                queue_depth=stats["queue_depth"],
+                queue_depth_by_priority=stats["queue_depth_by_priority"],
+                rolling_p99_ms=_pct(rolling, 99.0),
+                completed=stats["completed"],
+                shed=stats["shed"],
+            )
+
+    def runner(samples: List[np.ndarray]):
+        return engine.predict_logits(np.stack(samples))
+
+    batcher = MicroBatcher(
+        runner,
+        max_batch=engine.buckets[-1],
+        max_queue=cfg.queue_depth,
+        max_delay_ms=cfg.max_delay_ms,
+        on_batch=on_batch,
+        priorities=cfg.priorities,
+    )
+
+    shape = (engine.image_size, engine.image_size, 3)
+    nbytes = int(np.prod(shape)) * 4
+
+    def decode(body: bytes, content_type: str):
+        # raw float32 little-endian pixels (the loadgen's wire format),
+        # or a JSON-encoded nested list for hand-rolled curl clients
+        if content_type.startswith("application/octet-stream"):
+            if len(body) != nbytes:
+                raise ValueError(
+                    f"want {nbytes} bytes of float32 {shape}, got "
+                    f"{len(body)}"
+                )
+            return np.frombuffer(body, np.float32).reshape(shape).copy()
+        arr = np.asarray(json.loads(body), np.float32)
+        if arr.shape != shape:
+            raise ValueError(f"want shape {shape}, got {arr.shape}")
+        return arr
+
+    def encode(logits: np.ndarray):
+        return {
+            "pred": int(np.argmax(logits)),
+            "logits": [round(float(x), 4) for x in np.asarray(logits)],
+        }
+
+    front = HttpFrontEnd(
+        batcher,
+        admission,
+        ready_fn=lambda: engine.warmed,
+        decode=decode,
+        encode=encode,
+        host=cfg.host,
+        port=cfg.port,
+        max_body_bytes=int(cfg.max_body_mb * 2**20),
+    )
+    host, port = front.start()
+    events.emit(
+        "http",
+        phase="start",
+        host=host,
+        port=port,
+        artifact=os.path.abspath(cfg.artifact),
+        arch=engine.arch,
+        buckets=list(engine.buckets),
+        priorities=cfg.priorities,
+        queue_depth=cfg.queue_depth,
+        max_delay_ms=cfg.max_delay_ms,
+        scenario=cfg.scenario or None,
+        rate_rps=cfg.rate if cfg.scenario else None,
+        requests=cfg.requests if cfg.scenario else None,
+    )
+    warmup_s = engine.warmup()  # readyz flips 200 when this returns
+    events.emit(
+        "http", phase="ready", warmup_compile_s=dict(warmup_s),
+        host=host, port=port,
+    )
+
+    # periodic live-state events: per-priority depths, per-tenant
+    # sheds, readiness — what `watch` renders for a serving run
+    stats_stop = threading.Event()
+
+    def stats_pump():
+        while not stats_stop.wait(cfg.stats_interval_s):
+            s = front.stats()
+            events.emit(
+                "http",
+                phase="stats",
+                state=s["state"],
+                inflight=s["inflight"],
+                requests_seen=s["requests_seen"],
+                queue_depth_by_priority=[
+                    q["queue_depth"] for q in s["batcher"]["per_priority"]
+                ],
+                completed_by_priority=s["completed_by_priority"],
+                shed_by_priority=s["shed_by_priority"],
+                tenants={
+                    t: {
+                        "admitted": c["admitted"],
+                        "over_quota": c["over_quota"],
+                        "shed": c["shed"],
+                    }
+                    for t, c in s["admission"]["tenants"].items()
+                },
+            )
+
+    pump = threading.Thread(target=stats_pump, daemon=True)
+    pump.start()
+
+    client_raw = None
+    try:
+        if cfg.scenario:
+            rng = np.random.default_rng(cfg.seed)
+            pool = rng.standard_normal((32, *shape)).astype(np.float32)
+            bodies = [np.ascontiguousarray(x).tobytes() for x in pool]
+            schedule = build_schedule(
+                cfg.scenario,
+                requests=cfg.requests,
+                rate=cfg.rate,
+                seed=cfg.seed,
+                priorities=cfg.priorities,
+                priority_weights=(
+                    list(cfg.priority_weights)
+                    if cfg.priority_weights else None
+                ),
+                tenants=cfg.tenants,
+                tenant_weights=(
+                    list(cfg.tenant_weights)
+                    if cfg.tenant_weights else None
+                ),
+                flash_factor=cfg.flash_factor,
+                diurnal_amp=cfg.diurnal_amp,
+                heavy_sigma=cfg.heavy_sigma,
+                slow_fraction=cfg.slow_fraction,
+            )
+            gen = HttpLoadGenerator(
+                host,
+                port,
+                schedule,
+                body_fn=lambda i: bodies[i % len(bodies)],
+                concurrency=cfg.concurrency,
+                stop_fn=lambda: handler.preempted,
+                slow_chunks=cfg.slow_chunks,
+                slow_gap_s=cfg.slow_gap_ms / 1000.0,
+            )
+            client_raw = gen.run()
+        else:
+            while not handler.preempted:
+                time.sleep(0.1)
+    finally:
+        preempted = handler.preempted
+        events.emit(
+            "http",
+            phase="drain",
+            signum=handler.signum,
+            preempted=preempted,
+        )
+        drained_clean = front.drain(timeout=120.0)
+        stats_stop.set()
+        pump.join(timeout=5.0)
+
+    admission_stats = admission.stats()
+    events.emit("admission", phase="summary", **admission_stats)
+    verdict = http_slo_verdict(
+        front.accounting(),
+        batcher.stats(),
+        admission_stats,
+        scenario=cfg.scenario or "serve",
+        # serve mode runs no load generator: recording cfg.rate there
+        # would fabricate an offered-load figure nothing measured
+        rate=cfg.rate if cfg.scenario else None,
+        seed=cfg.seed,
+        provenance={
+            "artifact": os.path.abspath(cfg.artifact),
+            "arch": engine.arch,
+            "dataset": engine.dataset,
+            "config_hash": prov.get("config_hash"),
+            "recipe": recipe,
+            "serve_config_hash": manifest.get("config_hash"),
+        },
+        warmup_s=warmup_s,
+        preempted=preempted,
+        drained_clean=drained_clean,
+        client=client_raw,
+        slo_p99_ms=cfg.slo_p99_ms,
+    )
+    events.emit("serve", phase="verdict", **verdict)
+    events.emit("http", phase="stop", host=host, port=port)
+    events.close()
+    for out in (os.path.join(run_dir, VERDICT_NAME), cfg.out or None):
+        if out:
+            tmp = out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(verdict, f, indent=2, sort_keys=True)
+            os.replace(tmp, out)
+    return {
+        "verdict": verdict,
+        "run_dir": run_dir,
+        "host": host,
+        "port": port,
+    }
+
+
+__all__ = ["HttpFrontEnd", "PREDICT_PATH", "run_serve_http"]

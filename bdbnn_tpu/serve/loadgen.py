@@ -1,4 +1,18 @@
-"""SLO load generator + the ``serve-bench`` orchestration.
+"""SLO load generators + the ``serve-bench`` orchestration.
+
+Three layers:
+
+1. the in-process :class:`LoadGenerator` (PR 5) driving a submit
+   callable closed- or open-loop;
+2. the traffic-shaped arrival processes (:func:`build_schedule`:
+   poisson / diurnal / flash-crowd / heavy-tail / slow-client — all
+   pre-drawn from the seed, so the OFFERED load is deterministic) and
+   the raw-socket :class:`HttpLoadGenerator` that replays a schedule
+   against the network front end (serve/http.py) over real TCP;
+3. the strict-JSON SLO verdict builders (:func:`slo_verdict` v1
+   aggregates; :func:`http_slo_verdict` adds the v2 per-priority
+   latency blocks, per-tenant shed rates and the max/min fairness
+   ratio).
 
 Two canonical load models (Schroeder et al.'s open-vs-closed
 distinction):
@@ -36,25 +50,43 @@ import json
 import math
 import os
 import random
+import socket
 import threading
 import time
+from collections import namedtuple
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from bdbnn_tpu.serve.batching import LoadShedError, MicroBatcher
 
 VERDICT_NAME = "verdict.json"
-VERDICT_SCHEMA_VERSION = 1
+# v2: per-priority latency blocks, per-tenant shed rates, fairness
+# ratio and the scenario name joined the verdict (serve/http.py); v1
+# aggregate fields are unchanged, so v1 consumers keep working
+VERDICT_SCHEMA_VERSION = 2
 
 
-def percentile(sorted_vals: List[float], q: float) -> Optional[float]:
-    """Nearest-rank percentile over an ASCENDING list (q in [0, 100]);
-    None on empty input. Nearest-rank (not interpolated) so the verdict
-    is reproducible across numpy versions and needs no numpy at all."""
+def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over an ASCENDING sequence; None on an
+    empty window (the caller renders "no data", never crashes on the
+    exact moment — startup, post-drain — it is most likely to look).
+    A singleton window answers every q with its one sample. q outside
+    [0, 100] is a caller bug and raises. Nearest-rank (not
+    interpolated) so the verdict is reproducible across numpy versions
+    and needs no numpy at all."""
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile q must be in [0, 100], got {q!r}")
     if not sorted_vals:
         return None
     rank = max(int(math.ceil(q / 100.0 * len(sorted_vals))), 1)
-    return sorted_vals[rank - 1]
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def _pct(vals: Sequence[float], q: float, digits: int = 3) -> Optional[float]:
+    """None-propagating rounded percentile — the verdict/stats helper
+    that makes empty windows land as null instead of a TypeError."""
+    v = percentile(vals, q)
+    return None if v is None else round(v, digits)
 
 
 class LoadGenerator:
@@ -228,6 +260,358 @@ class LoadGenerator:
             }
 
 
+# ---------------------------------------------------------------------------
+# Traffic-shaped arrival processes + the socket load generator
+# ---------------------------------------------------------------------------
+
+# one scheduled request of a scenario: seconds-from-start, priority
+# class, tenant, and whether the CLIENT dribbles the body (slow-client
+# scenario — the server must tolerate slow writers without stalling
+# everyone else)
+Arrival = namedtuple("Arrival", ("t", "priority", "tenant", "slow"))
+
+SCENARIOS = (
+    "poisson", "diurnal", "flash_crowd", "heavy_tail", "slow_client",
+)
+
+
+def _weighted_pick(rng: random.Random, options: Sequence, weights) -> Any:
+    """Deterministic weighted draw from a seeded Random (no
+    random.choices: one rng.random() per draw keeps the consumption
+    schedule obvious and stable)."""
+    total = float(sum(weights))
+    x = rng.random() * total
+    acc = 0.0
+    for opt, w in zip(options, weights):
+        acc += float(w)
+        if x < acc:
+            return opt
+    return options[-1]
+
+
+def build_schedule(
+    scenario: str,
+    *,
+    requests: int,
+    rate: float,
+    seed: int,
+    priorities: int = 3,
+    priority_weights: Optional[Sequence[float]] = None,
+    tenants: Sequence[str] = ("tenant-a", "tenant-b"),
+    tenant_weights: Optional[Sequence[float]] = None,
+    flash_factor: float = 8.0,
+    diurnal_amp: float = 0.8,
+    heavy_sigma: float = 1.5,
+    slow_fraction: float = 0.2,
+) -> List[Arrival]:
+    """A deterministic arrival schedule for one scenario — drawn up
+    front from ``random.Random(seed)``, so the OFFERED load is
+    seed-reproducible regardless of how the server responds.
+
+    - ``poisson``      constant-rate memoryless arrivals (PR 5's open
+      loop, now with priorities/tenants attached)
+    - ``diurnal``      a full sinusoidal day compressed into the run:
+      rate(t) = rate·(1 + amp·sin(2πt/T)), T = the nominal run length
+      — exercises sustained swing between underload and overload
+    - ``flash_crowd``  baseline Poisson with a ``flash_factor``×
+      burst over the middle sixth of the run — the thundering herd
+      that must shed LOW classes while priority 0 keeps its p99
+    - ``heavy_tail``   lognormal inter-arrivals (σ = ``heavy_sigma``)
+      with the mean matched to 1/rate: long quiet stretches punctuated
+      by dense clumps, the realistic non-Poisson mix
+    - ``slow_client``  Poisson arrivals where a seeded
+      ``slow_fraction`` of requests dribble their body bytes — the
+      server must not let a slow writer stall fast ones
+
+    Priorities and tenants are drawn per request from the seeded RNG
+    (defaults: 10%% priority-0, 30%% priority-1, 60%% priority-2;
+    uniform tenants) — pass explicit weights to skew."""
+    if scenario not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {scenario!r} (want one of {SCENARIOS})"
+        )
+    if requests <= 0 or rate <= 0:
+        raise ValueError("need requests > 0 and rate > 0")
+    if priority_weights is None:
+        # default mix: a thin premium class over a broad best-effort
+        # base, truncated/padded to the configured class count
+        base = [0.1, 0.3, 0.6]
+        priority_weights = (
+            base[:priorities]
+            if priorities <= 3
+            else base + [0.6] * (priorities - 3)
+        )
+    if len(priority_weights) != priorities:
+        raise ValueError(
+            f"priority_weights must have {priorities} entries, got "
+            f"{len(priority_weights)}"
+        )
+    if tenant_weights is None:
+        tenant_weights = [1.0] * len(tenants)
+    if len(tenant_weights) != len(tenants):
+        raise ValueError(
+            f"tenant_weights must have {len(tenants)} entries, got "
+            f"{len(tenant_weights)}"
+        )
+    rng = random.Random(seed)
+    duration = requests / rate  # nominal run length at the base rate
+    flash_t0, flash_t1 = duration / 3.0, duration / 3.0 + duration / 6.0
+    mu = math.log(1.0 / rate) - heavy_sigma**2 / 2.0
+
+    out: List[Arrival] = []
+    t = 0.0
+    for _ in range(int(requests)):
+        if scenario == "heavy_tail":
+            gap = rng.lognormvariate(mu, heavy_sigma)
+        else:
+            r = rate
+            if scenario == "diurnal":
+                r = max(
+                    rate * (1.0 + diurnal_amp
+                            * math.sin(2.0 * math.pi * t / duration)),
+                    rate * 0.05,
+                )
+            elif scenario == "flash_crowd" and flash_t0 <= t < flash_t1:
+                r = rate * flash_factor
+            gap = rng.expovariate(r)
+        t += gap
+        slow = scenario == "slow_client" and rng.random() < slow_fraction
+        out.append(Arrival(
+            t=t,
+            priority=_weighted_pick(
+                rng, list(range(priorities)), priority_weights
+            ),
+            tenant=_weighted_pick(rng, list(tenants), tenant_weights),
+            slow=slow,
+        ))
+    return out
+
+
+def _recv_response(rfile) -> Tuple[int, Dict[str, str], bytes]:
+    """Minimal HTTP/1.1 response parse off a socket makefile('rb')."""
+    line = rfile.readline()
+    if not line:
+        raise ConnectionError("server closed the connection")
+    parts = line.decode("latin-1").split(None, 2)
+    status = int(parts[1])
+    headers: Dict[str, str] = {}
+    while True:
+        h = rfile.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = h.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+    n = int(headers.get("content-length", 0) or 0)
+    body = rfile.read(n) if n else b""
+    return status, headers, body
+
+
+class HttpLoadGenerator:
+    """Offer a prebuilt :func:`build_schedule` schedule to a live
+    server over REAL sockets (raw stdlib sockets — slow-client body
+    dribble needs byte-level control no high-level client gives).
+
+    A dispatcher walks the schedule by wall clock and hands each
+    arrival to a worker pool (``concurrency`` persistent keep-alive
+    connections); latency is measured from the SCHEDULED arrival, so a
+    backlogged pool charges the delay to the requests that suffered it
+    (no coordinated omission). ``stop_fn`` is polled between arrivals
+    — the SIGTERM latch.
+
+    The ledger separates the outcomes that matter for the drain
+    contract: every request must get SOME response (2xx/4xx/5xx);
+    ``dropped`` counts requests that got none — the number the
+    zero-dropped acceptance test pins at 0."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        schedule: Sequence[Arrival],
+        *,
+        body_fn: Callable[[int], bytes],
+        content_type: str = "application/octet-stream",
+        path: str = "/v1/predict",
+        concurrency: int = 16,
+        stop_fn: Callable[[], bool] = lambda: False,
+        slow_chunks: int = 4,
+        slow_gap_s: float = 0.02,
+        timeout_s: float = 60.0,
+    ):
+        self.host = host
+        self.port = int(port)
+        self.schedule = list(schedule)
+        self.body_fn = body_fn
+        self.content_type = content_type
+        self.path = path
+        self.concurrency = max(int(concurrency), 1)
+        self.stop_fn = stop_fn
+        self.slow_chunks = max(int(slow_chunks), 1)
+        self.slow_gap_s = float(slow_gap_s)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self.by_status: Dict[int, int] = {}
+        self.dropped = 0
+        self.submitted = 0
+        self.lat_by_priority: Dict[int, List[float]] = {}
+
+    # -- one request over one (reused) connection ----------------------
+
+    def _connect(self):
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        )
+        return sock, sock.makefile("rb")
+
+    def _send(self, sock, i: int, arr: Arrival) -> None:
+        body = self.body_fn(i)
+        head = (
+            f"POST {self.path} HTTP/1.1\r\n"
+            f"host: {self.host}:{self.port}\r\n"
+            f"x-priority: {arr.priority}\r\n"
+            f"x-tenant: {arr.tenant}\r\n"
+            f"content-type: {self.content_type}\r\n"
+            f"content-length: {len(body)}\r\n\r\n"
+        ).encode("latin-1")
+        if not arr.slow:
+            sock.sendall(head + body)
+            return
+        # slow client: headers at once, then the body in dribbled
+        # chunks — the server's reader must wait it out without
+        # blocking anyone else's requests
+        sock.sendall(head)
+        step = max(len(body) // self.slow_chunks, 1)
+        for off in range(0, len(body), step):
+            sock.sendall(body[off:off + step])
+            time.sleep(self.slow_gap_s)
+
+    def _one(self, conn, i: int, arr: Arrival, t_sched: float):
+        """Returns (conn, status|None); reconnects once on a torn
+        keep-alive connection before counting the request dropped."""
+        for attempt in (0, 1):
+            if conn is None:
+                try:
+                    conn = self._connect()
+                except OSError:
+                    conn = None
+                    continue
+            sock, rfile = conn
+            try:
+                self._send(sock, i, arr)
+                status, headers, _body = _recv_response(rfile)
+            except (OSError, ValueError, ConnectionError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                conn = None
+                continue
+            lat_ms = (time.perf_counter() - t_sched) * 1000.0
+            with self._lock:
+                self.by_status[status] = self.by_status.get(status, 0) + 1
+                if status == 200:
+                    self.lat_by_priority.setdefault(
+                        arr.priority, []
+                    ).append(lat_ms)
+            if headers.get("connection", "").lower() == "close":
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                conn = None
+            return conn, status
+        with self._lock:
+            self.dropped += 1
+        return conn, None
+
+    def run(self) -> Dict[str, Any]:
+        import queue as _queue
+
+        work: "_queue.Queue" = _queue.Queue()
+
+        def worker():
+            conn = None
+            while True:
+                item = work.get()
+                if item is None:
+                    break
+                i, arr, t_sched = item
+                conn, _status = self._one(conn, i, arr, t_sched)
+            if conn is not None:
+                try:
+                    conn[0].close()
+                except OSError:
+                    pass
+
+        workers = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self.concurrency)
+        ]
+        for w in workers:
+            w.start()
+        t0 = time.perf_counter()
+        for i, arr in enumerate(self.schedule):
+            delay = (t0 + arr.t) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            if self.stop_fn():
+                break
+            with self._lock:
+                self.submitted += 1
+            # latency clock starts at the SCHEDULED arrival
+            work.put((i, arr, t0 + arr.t))
+        for _ in workers:
+            work.put(None)
+        for w in workers:
+            w.join(self.timeout_s)
+        wall_s = time.perf_counter() - t0
+        with self._lock:
+            responses = sum(self.by_status.values())
+            # a worker outliving its join (server wedged past
+            # timeout_s) holds requests that are in `submitted` but in
+            # neither `responses` nor `dropped` — they got NO answer
+            # within the measurement, which is exactly what `dropped`
+            # exists to count; the zero-dropped gate must not pass them
+            missing = self.submitted - responses - self.dropped
+            if missing > 0:
+                self.dropped += missing
+            return {
+                "submitted": self.submitted,
+                "responses": responses,
+                "dropped": self.dropped,
+                "by_status": {
+                    str(k): v for k, v in sorted(self.by_status.items())
+                },
+                "wall_s": round(wall_s, 3),
+                "p99_ms_by_priority": {
+                    str(p): _pct(sorted(v), 99.0)
+                    for p, v in sorted(self.lat_by_priority.items())
+                },
+            }
+
+
+def fairness_ratio(
+    per_tenant: Dict[str, Dict[str, Any]],
+) -> Optional[float]:
+    """Max/min ratio of per-tenant SERVICE rates (completed/submitted)
+    over tenants that offered load: 1.0 = perfectly even service, large
+    = somebody is starving. None when fewer than two tenants offered
+    load, or when a tenant got NOTHING through (an infinite ratio is
+    not a number a tolerance can judge — the per-tenant table carries
+    the zero explicitly)."""
+    rates = []
+    for t in per_tenant.values():
+        submitted = t.get("submitted") or 0
+        if submitted > 0:
+            rates.append((t.get("completed") or 0) / submitted)
+    if len(rates) < 2:
+        return None
+    lo = min(rates)
+    if lo <= 0.0:
+        return None
+    return round(max(rates) / lo, 4)
+
+
 def slo_verdict(
     raw: Dict[str, Any],
     batcher_stats: Dict[str, Any],
@@ -239,30 +623,56 @@ def slo_verdict(
     warmup_s: Optional[Dict[str, float]] = None,
     preempted: bool = False,
     drained_clean: bool = True,
+    scenario: Optional[str] = None,
+    per_priority: Optional[Dict[str, Dict[str, Any]]] = None,
+    per_tenant: Optional[Dict[str, Dict[str, Any]]] = None,
+    fairness: Optional[float] = None,
+    client: Optional[Dict[str, Any]] = None,
+    slo: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
-    """Assemble the deterministic strict-JSON SLO verdict."""
+    """Assemble the deterministic strict-JSON SLO verdict.
+
+    The v1 aggregate block is unchanged; the serving front end
+    (serve/http.py) adds the v2 blocks: ``scenario`` (arrival-process
+    name), ``per_priority`` ({"0": {submitted/completed/shed_*/p50/
+    p95/p99}, ...}), ``per_tenant`` (admission counters + shed_rate
+    per tenant), ``fairness_ratio`` (max/min per-tenant service rate),
+    ``client`` (the socket load generator's own observation — the
+    zero-dropped cross-check) and ``slo`` (a target judged at verdict
+    time)."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
     verdict = {
         "serve_verdict": VERDICT_SCHEMA_VERSION,
         "mode": mode,
-        "rate_rps": rate if mode == "open" else None,
+        "rate_rps": rate if mode != "closed" else None,
         "seed": seed,
+        "scenario": scenario,
         "requests_submitted": raw["submitted"],
         "requests_completed": raw["completed"],
         "requests_shed": raw["shed"],
         "requests_failed": raw.get("failed", 0),
+        # malformed-body 400s (serve-http): the tenant's own bad
+        # requests — neither completed nor shed nor failed, so the
+        # ledger identity completed+shed+failed+rejected == submitted
+        # survives bad clients
+        "requests_rejected": raw.get("rejected", 0),
         "shed_rate": round(raw["shed"] / submitted, 6),
-        "p50_ms": round(percentile(lats, 50.0), 3) if lats else None,
-        "p95_ms": round(percentile(lats, 95.0), 3) if lats else None,
-        "p99_ms": round(percentile(lats, 99.0), 3) if lats else None,
+        "p50_ms": _pct(lats, 50.0),
+        "p95_ms": _pct(lats, 95.0),
+        "p99_ms": _pct(lats, 99.0),
         "throughput_rps": round(raw["completed"] / wall, 3),
         "wall_s": round(wall, 3),
         "mean_batch_occupancy": batcher_stats.get("mean_occupancy"),
         "batches": batcher_stats.get("batches"),
         "max_queue_depth_seen": batcher_stats.get("max_queue_depth_seen"),
         "max_queue": batcher_stats.get("max_queue"),
+        "per_priority": per_priority,
+        "per_tenant": per_tenant,
+        "fairness_ratio": fairness,
+        "client": client,
+        "slo": slo,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (
@@ -275,6 +685,107 @@ def slo_verdict(
     from bdbnn_tpu.obs.events import jsonsafe
 
     return jsonsafe(verdict)
+
+
+def http_slo_verdict(
+    accounting: Dict[str, Any],
+    batcher_stats: Dict[str, Any],
+    admission_stats: Dict[str, Any],
+    *,
+    scenario: str,
+    rate: float,
+    seed: int,
+    provenance: Optional[Dict[str, Any]] = None,
+    warmup_s: Optional[Dict[str, float]] = None,
+    preempted: bool = False,
+    drained_clean: bool = True,
+    client: Optional[Dict[str, Any]] = None,
+    slo_p99_ms: float = 0.0,
+) -> Dict[str, Any]:
+    """Build the v2 verdict from the HTTP front end's request ledger
+    (:meth:`serve.http.HttpFrontEnd.accounting`), the batcher's
+    per-priority occupancy and the admission controller's per-tenant
+    counters — the three sources of truth, joined exactly once."""
+    lat_p = accounting["latencies_ms_by_priority"]
+    counts_p = accounting["counts_by_priority"]
+    per_priority: Dict[str, Dict[str, Any]] = {}
+    all_lats: List[float] = []
+    for p, (lats, counts) in enumerate(zip(lat_p, counts_p)):
+        all_lats += lats
+        shed = (
+            counts["shed_draining"] + counts["shed_over_quota"]
+            + counts["shed_queue_full"]
+        )
+        per_priority[str(p)] = {
+            "submitted": counts["submitted"],
+            "completed": counts["completed"],
+            "failed": counts["failed"],
+            "rejected": counts.get("rejected", 0),
+            "shed": shed,
+            "shed_draining": counts["shed_draining"],
+            "shed_over_quota": counts["shed_over_quota"],
+            "shed_queue_full": counts["shed_queue_full"],
+            "shed_rate": round(
+                shed / max(counts["submitted"], 1), 6
+            ),
+            "p50_ms": _pct(lats, 50.0),
+            "p95_ms": _pct(lats, 95.0),
+            "p99_ms": _pct(lats, 99.0),
+        }
+    per_tenant: Dict[str, Dict[str, Any]] = {}
+    for tenant, c in (admission_stats.get("tenants") or {}).items():
+        submitted = c["admitted"] + c["over_quota"]
+        per_tenant[tenant] = {
+            "submitted": submitted,
+            "admitted": c["admitted"],
+            "completed": c["completed"],
+            "failed": c["failed"],
+            "rejected": c.get("rejected", 0),
+            "over_quota": c["over_quota"],
+            "shed_queue": c["shed"],
+            "shed_rate": c["shed_rate"],
+            "quota_rate": c["quota_rate"],
+            "quota_burst": c["quota_burst"],
+        }
+    submitted = sum(c["submitted"] for c in counts_p)
+    completed = sum(c["completed"] for c in counts_p)
+    failed = sum(c["failed"] for c in counts_p)
+    rejected = sum(c.get("rejected", 0) for c in counts_p)
+    shed = sum(v["shed"] for v in per_priority.values())
+    all_lats.sort()
+    slo = None
+    if slo_p99_ms > 0:
+        p0_p99 = per_priority.get("0", {}).get("p99_ms")
+        slo = {
+            "p99_ms_target_priority0": slo_p99_ms,
+            "p99_ms_priority0": p0_p99,
+            "met": bool(p0_p99 is not None and p0_p99 <= slo_p99_ms),
+        }
+    return slo_verdict(
+        {
+            "submitted": submitted,
+            "completed": completed,
+            "shed": shed,
+            "failed": failed,
+            "rejected": rejected,
+            "wall_s": accounting["wall_s"],
+            "latencies_ms": all_lats,
+        },
+        batcher_stats,
+        mode="http",
+        rate=rate,
+        seed=seed,
+        provenance=provenance,
+        warmup_s=warmup_s,
+        preempted=preempted,
+        drained_clean=drained_clean,
+        scenario=scenario,
+        per_priority=per_priority,
+        per_tenant=per_tenant,
+        fairness=fairness_ratio(per_tenant),
+        client=client,
+        slo=slo,
+    )
 
 
 def run_serve_bench(cfg) -> Dict[str, Any]:
@@ -375,7 +886,7 @@ def _serve_bench_body(cfg, handler) -> Dict[str, Any]:
                 batch_size=stats["batch_size"],
                 occupancy=stats["occupancy"],
                 queue_depth=stats["queue_depth"],
-                rolling_p99_ms=round(percentile(rolling, 99.0), 3),
+                rolling_p99_ms=_pct(rolling, 99.0),
                 completed=stats["completed"],
                 shed=stats["shed"],
             )
@@ -446,9 +957,15 @@ def _serve_bench_body(cfg, handler) -> Dict[str, Any]:
 
 
 __all__ = [
+    "SCENARIOS",
     "VERDICT_NAME",
     "VERDICT_SCHEMA_VERSION",
+    "Arrival",
+    "HttpLoadGenerator",
     "LoadGenerator",
+    "build_schedule",
+    "fairness_ratio",
+    "http_slo_verdict",
     "percentile",
     "run_serve_bench",
     "slo_verdict",

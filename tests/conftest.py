@@ -92,6 +92,102 @@ def rng():
     return np.random.default_rng(0)
 
 
+# ---------------------------------------------------------------------------
+# Network front-end harness (tests/test_http.py): a session-scoped
+# free-port allocator (two fixtures in one session never race for the
+# same port) and a server-lifecycle factory that guarantees every
+# started front end is drained at teardown, pass or fail.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="session")
+def port_allocator():
+    """Session-scoped free-port allocator: bind port 0, let the kernel
+    pick, remember the pick so no two callers in this session get the
+    same port (the kernel can re-issue a closed listener's port)."""
+    import socket as _socket
+
+    handed = set()
+
+    def alloc() -> int:
+        while True:
+            s = _socket.socket()
+            try:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            finally:
+                s.close()
+            if port not in handed:
+                handed.add(port)
+                return port
+
+    return alloc
+
+
+@pytest.fixture
+def free_port(port_allocator):
+    return port_allocator()
+
+
+@pytest.fixture
+def http_frontend(port_allocator):
+    """Factory for stub-runner HTTP front ends (no JAX): returns
+    ``make(runner=..., **kw) -> HttpFrontEnd`` already started on a
+    fresh port; every started server is drained at teardown even when
+    the test failed mid-request."""
+    from bdbnn_tpu.serve.admission import AdmissionController
+    from bdbnn_tpu.serve.batching import MicroBatcher
+    from bdbnn_tpu.serve.http import HttpFrontEnd
+
+    started = []
+
+    def make(
+        runner=None,
+        *,
+        priorities=3,
+        max_batch=8,
+        max_queue=16,
+        max_delay_ms=2.0,
+        default_rate=1e9,
+        default_burst=1e9,
+        quotas=None,
+        clock=None,
+        ready_fn=None,
+        **front_kw,
+    ):
+        if runner is None:
+            runner = lambda batch: list(batch)
+        batcher = MicroBatcher(
+            runner,
+            max_batch=max_batch,
+            max_queue=max_queue,
+            max_delay_ms=max_delay_ms,
+            priorities=priorities,
+        )
+        admission_kw = dict(
+            default_rate=default_rate,
+            default_burst=default_burst,
+            quotas=quotas or {},
+        )
+        if clock is not None:
+            admission_kw["clock"] = clock
+        admission = AdmissionController(**admission_kw)
+        fe = HttpFrontEnd(
+            batcher,
+            admission,
+            ready_fn=ready_fn or (lambda: True),
+            port=port_allocator(),
+            **front_kw,
+        )
+        fe.start()
+        started.append(fe)
+        return fe
+
+    yield make
+    for fe in started:
+        fe.drain(timeout=10.0)
+
+
 def write_synthetic_trace(path, n_steps=5):
     """A hand-built ``*.trace.json.gz`` in the Chrome-trace shape the
     jax profiler emits on TPU: a device process with named threads —
@@ -396,3 +492,15 @@ def tiny_trained_run_dir(tmp_path_factory):
     )
     fit(cfg)
     return resolve_run_dir(str(root))
+
+
+@pytest.fixture(scope="session")
+def exported_artifact(tiny_trained_run_dir, tmp_path_factory):
+    """ONE export artifact per session over the real trained fixture
+    run — shared by the serve-bench tests (test_serve.py) and the HTTP
+    front-end e2e (test_http.py). Returns (artifact_dir, artifact)."""
+    from bdbnn_tpu.serve.export import export_artifact
+
+    out = str(tmp_path_factory.mktemp("artifact") / "art")
+    artifact = export_artifact(tiny_trained_run_dir, out)
+    return out, artifact

@@ -87,13 +87,15 @@ class TestEmitCallSites:
         )
         # the scan actually saw the package's core kinds (guards
         # against the AST walk silently matching nothing) — including
-        # the four resilience kinds, the two health-monitor kinds, and
-        # the two serving kinds (serve/export.py, serve/loadgen.py),
-        # which must keep real call sites
+        # the four resilience kinds, the two health-monitor kinds, the
+        # two serving kinds (serve/export.py, serve/loadgen.py) and the
+        # two network-front-end kinds (serve/http.py), which must keep
+        # real call sites
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
-                "alert", "health", "export", "serve"} <= found
+                "alert", "health", "export", "serve",
+                "http", "admission"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync."""
@@ -207,6 +209,83 @@ class TestStrictRfc8259:
         assert lines[1]["buckets"] == [1, 8]
         assert lines[1]["preempted"] is False
         assert x["checkpoint_acc1"] is None and s["p50_ms"] == 4.25
+
+    def test_http_admission_kind_payloads_roundtrip(self, tmp_path):
+        """The real network-front-end payload shapes (serve/http.py)
+        with adversarial values in the numeric slots: NaN latencies in
+        the nested per-priority verdict blocks must land as null,
+        numpy counters must unwrap, and the per-tenant admission dicts
+        must survive strict parsing."""
+        ev = EventWriter(str(tmp_path))
+        h = ev.emit(
+            "http",
+            phase="stats",
+            state="ready",
+            inflight=np.int64(3),
+            requests_seen=1200,
+            queue_depth_by_priority=[np.int64(0), 2, np.int64(7)],
+            completed_by_priority=[100, np.int64(300), 800],
+            shed_by_priority=[0, 0, np.int64(41)],
+            tenants={
+                "tenant-a": {"admitted": np.int64(900),
+                             "over_quota": 0, "shed": np.int64(12)},
+                "tenant-b": {"admitted": 300, "over_quota": np.int64(41),
+                             "shed": 0},
+            },
+        )
+        d = ev.emit(
+            "http", phase="drain", signum=np.int64(15),
+            preempted=np.bool_(True),
+        )
+        a = ev.emit(
+            "admission",
+            phase="summary",
+            draining=np.bool_(True),
+            default_rate=np.float32(100.0),
+            default_burst=200.0,
+            tenants={
+                "tenant-a": {
+                    "admitted": np.int64(900), "over_quota": 0,
+                    "shed": 12, "completed": np.int64(888),
+                    "failed": 0, "shed_rate": np.float32("nan"),
+                    "quota_rate": float("inf"), "quota_burst": 200.0,
+                },
+            },
+        )
+        s = ev.emit(
+            "serve",
+            phase="verdict",
+            per_priority={
+                "0": {"submitted": np.int64(100), "completed": 100,
+                      "shed": 0, "p99_ms": np.float32(12.5)},
+                "2": {"submitted": 800, "completed": np.int64(759),
+                      "shed": 41, "p99_ms": float("nan")},
+            },
+            per_tenant={
+                "tenant-b": {"submitted": 341, "completed": np.int64(300),
+                             "shed_rate": np.float32(0.12)},
+            },
+            fairness_ratio=np.float32(1.33),
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "http"
+        assert lines[0]["queue_depth_by_priority"] == [0, 2, 7]
+        assert isinstance(lines[0]["inflight"], int)
+        assert lines[0]["tenants"]["tenant-b"]["over_quota"] == 41
+        assert lines[1]["signum"] == 15 and lines[1]["preempted"] is True
+        assert lines[2]["kind"] == "admission"
+        assert lines[2]["tenants"]["tenant-a"]["shed_rate"] is None  # NaN
+        assert lines[2]["tenants"]["tenant-a"]["quota_rate"] is None  # Inf
+        assert lines[2]["draining"] is True
+        assert lines[3]["per_priority"]["0"]["p99_ms"] == 12.5
+        assert lines[3]["per_priority"]["2"]["p99_ms"] is None
+        assert lines[3]["fairness_ratio"] == pytest.approx(1.33, abs=1e-3)
+        # the emit() return values match what was written
+        assert h["inflight"] == 3 and d["signum"] == 15
+        assert a["tenants"]["tenant-a"]["shed_rate"] is None
+        assert s["per_priority"]["2"]["p99_ms"] is None
 
     def test_resilience_kind_payloads_roundtrip(self, tmp_path):
         """The extended pod-resilience payload shapes (train/loop.py):

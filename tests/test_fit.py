@@ -47,6 +47,14 @@ class TestFitSmoke:
         res2 = fit(_cfg(tmp_path, epochs=2, resume=str(runs[0].parent)))
         assert np.isfinite(res2["best_acc1"])
 
+    # tier-1 budget (PR 7 rebalance, same rule as above): every piece
+    # of this combined smoke has denser tier-1 coverage on its own —
+    # remat identity vs the full loss+grads in test_models.TestRemat,
+    # EDE + the kurtosis gate inside REAL fits in the test_faults
+    # harness (FAULT_BASE runs ede=True, kurtepoch=1), and the
+    # kurtosis/EDE numerics in the fast oracle tier — so the broad
+    # all-flags-at-once fit rides the slow tier
+    @pytest.mark.slow
     def test_kurtosis_ede_remat_run(self, tmp_path):
         # remat=True rides along: the rematerialized blocks must work
         # under the full jitted/donated train step, not just raw grads

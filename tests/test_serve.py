@@ -261,6 +261,54 @@ class TestMicroBatcher:
         assert f2.result(timeout=5) == 2
         assert b.drain(timeout=5)
 
+    def test_strict_priority_dequeue_and_per_class_bounds(self):
+        """Priority 0 overtakes a queued priority-1 backlog, each class
+        sheds against its OWN bound, and stats()['per_priority'] is the
+        one aggregate the verdict/watch read."""
+        release = threading.Event()
+        executed = []
+
+        def runner(batch):
+            release.wait(10)
+            executed.extend(batch)
+            return batch
+
+        b = MicroBatcher(
+            runner, max_batch=1, max_queue=2, max_delay_ms=0.0,
+            priorities=2,
+        )
+        futs = [b.submit("wedge", priority=1)]  # pulled into the runner
+        time.sleep(0.05)
+        futs += [b.submit(f"low{i}", priority=1) for i in range(2)]
+        # class 1 is now full: its third submit sheds...
+        with pytest.raises(LoadShedError, match="queue full"):
+            b.submit("low-overflow", priority=1)
+        # ...while class 0 still has its own 2 slots
+        futs.append(b.submit("hi", priority=0))
+        with pytest.raises(ValueError, match="priority"):
+            b.submit("bad", priority=2)
+        release.set()
+        for f in futs:
+            f.result(timeout=5)
+        assert b.drain(timeout=5)
+        assert executed[0] == "wedge" and executed[1] == "hi"
+        s = b.stats()
+        assert [p["shed"] for p in s["per_priority"]] == [0, 1]
+        assert [p["completed"] for p in s["per_priority"]] == [1, 3]
+        assert s["shed"] == 1 and s["completed"] == 4
+        assert s["per_priority"][1]["max_queue_depth_seen"] == 2
+
+    def test_single_priority_stats_backwards_compatible(self):
+        b = MicroBatcher(lambda batch: batch, max_batch=4, max_queue=8)
+        futs = [b.submit(i) for i in range(6)]
+        for f in futs:
+            f.result(timeout=5)
+        assert b.drain(timeout=5)
+        s = b.stats()
+        assert s["priorities"] == 1
+        assert len(s["per_priority"]) == 1
+        assert s["per_priority"][0]["completed"] == s["completed"] == 6
+
 
 # ---------------------------------------------------------------------------
 # Load generator + SLO verdict (no JAX)
@@ -342,7 +390,11 @@ class TestLoadGen:
         )
         assert parsed["shed_rate"] == 0.2
         assert parsed["requests_completed"] == 8
-        assert parsed["serve_verdict"] == 1
+        assert parsed["serve_verdict"] == 2
+        # v1 consumers: the v2 blocks exist but are null on a plain
+        # serve-bench verdict
+        assert parsed["per_priority"] is None
+        assert parsed["fairness_ratio"] is None
         for k in ("p50_ms", "p95_ms", "p99_ms", "throughput_rps",
                   "mean_batch_occupancy", "drained_clean", "preempted"):
             assert k in parsed
@@ -351,15 +403,6 @@ class TestLoadGen:
 # ---------------------------------------------------------------------------
 # Export + engine over a REAL trained run (session fixture)
 # ---------------------------------------------------------------------------
-
-
-@pytest.fixture(scope="session")
-def exported_artifact(tiny_trained_run_dir, tmp_path_factory):
-    from bdbnn_tpu.serve.export import export_artifact
-
-    out = str(tmp_path_factory.mktemp("artifact") / "art")
-    artifact = export_artifact(tiny_trained_run_dir, out)
-    return out, artifact
 
 
 class TestExportArtifact:
@@ -662,9 +705,12 @@ class TestServeBench:
 # ---------------------------------------------------------------------------
 
 
-def _verdict_file(tmp_path, name, p99, thr, shed_rate, recipe=None):
+def _verdict_file(
+    tmp_path, name, p99, thr, shed_rate, recipe=None,
+    per_priority=None, per_tenant=None, fairness=None,
+):
     v = {
-        "serve_verdict": 1,
+        "serve_verdict": 2,
         "mode": "open",
         "p50_ms": p99 / 3, "p95_ms": p99 / 1.5, "p99_ms": p99,
         "throughput_rps": thr,
@@ -673,6 +719,9 @@ def _verdict_file(tmp_path, name, p99, thr, shed_rate, recipe=None):
         "requests_completed": int(100 * (1 - shed_rate)),
         "requests_shed": int(100 * shed_rate),
         "mean_batch_occupancy": 0.5,
+        "per_priority": per_priority,
+        "per_tenant": per_tenant,
+        "fairness_ratio": fairness,
         "provenance": {
             "config_hash": "cafe",
             "recipe": recipe
@@ -684,6 +733,14 @@ def _verdict_file(tmp_path, name, p99, thr, shed_rate, recipe=None):
     with open(path, "w") as f:
         json.dump(v, f)
     return path
+
+
+def _per_priority(p99s):
+    return {
+        str(p): {"submitted": 100, "completed": 100, "shed": 0,
+                 "p99_ms": v}
+        for p, v in enumerate(p99s)
+    }
 
 
 class TestCompareServeVerdicts:
@@ -717,6 +774,81 @@ class TestCompareServeVerdicts:
         cand = _verdict_file(tmp_path, "cand.json", 10.0, 1000.0, 0.05)
         r = compare_runs([base, cand], tol_rel=0.10)
         assert r["verdict"] == "regression"
+
+    def test_per_priority_p99_regression_caught(self, tmp_path):
+        """The aggregate p99 can look flat while ONE class regresses
+        (a flood of cheap low-priority traffic hides a priority-0
+        collapse in the mix) — the per-priority metrics catch exactly
+        that, and a regression there is exit-3 class."""
+        from bdbnn_tpu.obs.compare import compare_runs
+
+        base = _verdict_file(
+            tmp_path, "base.json", 10.0, 1000.0, 0.0,
+            per_priority=_per_priority([5.0, 8.0, 12.0]),
+        )
+        cand = _verdict_file(
+            tmp_path, "cand.json", 10.0, 1000.0, 0.0,
+            per_priority=_per_priority([20.0, 8.0, 12.0]),
+        )
+        r = compare_runs([base, cand], tol_rel=0.10)
+        assert r["verdict"] == "regression"
+        bad = [
+            m["metric"]
+            for c in r["comparisons"]
+            for m in c["metrics"]
+            if m["verdict"] == "regression"
+        ]
+        assert bad == ["serve_p99_ms_p0"]
+
+    def test_fairness_and_tenant_shed_metrics_judged(self, tmp_path):
+        from bdbnn_tpu.obs.compare import compare_runs
+
+        tenants_ok = {
+            "a": {"submitted": 50, "completed": 50, "shed_rate": 0.0},
+            "b": {"submitted": 50, "completed": 48, "shed_rate": 0.04},
+        }
+        tenants_bad = {
+            "a": {"submitted": 50, "completed": 50, "shed_rate": 0.0},
+            "b": {"submitted": 50, "completed": 25, "shed_rate": 0.5},
+        }
+        base = _verdict_file(
+            tmp_path, "base.json", 10.0, 1000.0, 0.0,
+            per_tenant=tenants_ok, fairness=1.04,
+        )
+        cand = _verdict_file(
+            tmp_path, "cand.json", 10.0, 1000.0, 0.0,
+            per_tenant=tenants_bad, fairness=2.0,
+        )
+        r = compare_runs([base, cand], tol_rel=0.10)
+        assert r["verdict"] == "regression"
+        rows = {
+            m["metric"]: m["verdict"]
+            for c in r["comparisons"]
+            for m in c["metrics"]
+        }
+        assert rows["serve_fairness_ratio"] == "regression"
+        assert rows["serve_tenant_shed_rate_max"] == "regression"
+
+    def test_v1_verdict_still_compares_on_aggregates(self, tmp_path):
+        """A pre-PR7 verdict (no per_priority/per_tenant blocks) still
+        aligns and judges on the v1 aggregate metrics — None rows are
+        skipped, never phantom-judged."""
+        from bdbnn_tpu.obs.compare import compare_runs
+
+        base = _verdict_file(tmp_path, "base.json", 10.0, 1000.0, 0.0)
+        cand = _verdict_file(
+            tmp_path, "cand.json", 10.5, 990.0, 0.0,
+            per_priority=_per_priority([5.0, 8.0, 12.0]),
+        )
+        r = compare_runs([base, cand], tol_rel=0.10)
+        assert r["verdict"] == "pass"
+        judged = {
+            m["metric"]
+            for c in r["comparisons"]
+            for m in c["metrics"]
+        }
+        assert "serve_p99_ms" in judged
+        assert "serve_p99_ms_p0" not in judged  # baseline side is None
 
     def test_export_provenance_mismatch_refused(self, tmp_path):
         from bdbnn_tpu.obs.compare import compare_runs
