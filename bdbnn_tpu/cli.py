@@ -676,6 +676,29 @@ def serve_bench_main(argv) -> int:
         help="rotate the serve run's events.jsonl past this size in "
         "MiB (default 256; 0 = unbounded) — same knob as training",
     )
+    ap.add_argument(
+        "--replicas", type=int, nargs="+", default=[1],
+        help="replica-pool size(s): one AOT-warmed engine per mesh "
+        "device behind the front batcher. More than one value runs a "
+        "SCALING SWEEP (one pass per N; the verdict gains the scaling "
+        "block compare judges as serve_scaling_efficiency)",
+    )
+    ap.add_argument(
+        "--pace-ms", type=float, default=0.0,
+        help="fabric mode: replace each replica's engine with a fixed "
+        "sleep per batch — measures the pool's dispatch concurrency "
+        "where CPU-simulated devices share one host's cores (0 = real "
+        "engines; on-chip sweeps run unpaced)",
+    )
+    ap.add_argument(
+        "--replica-queue-batches", type=int, default=8,
+        help="per-replica bounded queue, in batches (default 8)",
+    )
+    ap.add_argument(
+        "--wedge-timeout-s", type=float, default=30.0,
+        help="a replica busy on one batch longer than this is marked "
+        "unhealthy, routed around and restarted (default 30)",
+    )
     args = ap.parse_args(argv)
 
     _force_jax_platforms()
@@ -695,6 +718,10 @@ def serve_bench_main(argv) -> int:
         seed=args.seed,
         out=args.out,
         events_max_mb=args.events_max_mb,
+        replicas=tuple(args.replicas),
+        pace_ms=args.pace_ms,
+        replica_queue_batches=args.replica_queue_batches,
+        wedge_timeout_s=args.wedge_timeout_s,
     )
     result = run_serve_bench(cfg)
     print(json.dumps(result["verdict"], indent=2, sort_keys=True))
@@ -803,6 +830,34 @@ def serve_http_main(argv) -> int:
         "--out", default="", help="also write the SLO verdict JSON here",
     )
     ap.add_argument("--events-max-mb", type=float, default=256.0)
+    ap.add_argument(
+        "--replicas", type=int, default=1,
+        help="replica-pool size: N data-parallel engines, one per mesh "
+        "device, behind the front batcher (default 1 = single engine)",
+    )
+    ap.add_argument(
+        "--registry", default="",
+        help="artifact registry root (serve/registry.py): lets "
+        "ARTIFACT and --swap-to name published versions (vNNNN), "
+        "digest-verified, and enables POST /admin/swap {\"version\": N}",
+    )
+    ap.add_argument(
+        "--swap-to", default="",
+        help="blue/green hot-swap target: a registry version (vNNNN, "
+        "with --registry) or an artifact dir",
+    )
+    ap.add_argument(
+        "--swap-at", type=float, default=0.0,
+        help="with --scenario: fire the swap after this fraction of "
+        "the schedule has been offered (the swap-under-load bench); "
+        "0 = no scheduled swap (POST /admin/swap still works)",
+    )
+    ap.add_argument("--replica-queue-batches", type=int, default=8)
+    ap.add_argument(
+        "--wedge-timeout-s", type=float, default=30.0,
+        help="a replica busy on one batch longer than this is marked "
+        "unhealthy, routed around and restarted (default 30)",
+    )
     args = ap.parse_args(argv)
 
     _force_jax_platforms()
@@ -835,6 +890,12 @@ def serve_http_main(argv) -> int:
         seed=args.seed,
         out=args.out,
         events_max_mb=args.events_max_mb,
+        replicas=args.replicas,
+        registry=args.registry,
+        swap_to=args.swap_to,
+        swap_at=args.swap_at,
+        replica_queue_batches=args.replica_queue_batches,
+        wedge_timeout_s=args.wedge_timeout_s,
     )
     result = run_serve_http(cfg)
     print(json.dumps(result["verdict"], indent=2, sort_keys=True))
@@ -861,6 +922,23 @@ def serve_http_main(argv) -> int:
             file=sys.stderr,
         )
         return 1
+    swap = result["verdict"].get("swap")
+    if swap is not None and (
+        not swap.get("performed") or (swap.get("shed") or 0) > 0
+    ):
+        # the zero-downtime contract: a rollout that failed, or that
+        # CAUSED load shedding while it rolled, is not a clean swap
+        print(
+            f"[serve-http] swap to {swap.get('version_to')} "
+            + (
+                f"shed {swap.get('shed')} request(s) while rolling"
+                if swap.get("performed")
+                else f"did not complete (state {swap.get('state')}: "
+                f"{swap.get('error')})"
+            ),
+            file=sys.stderr,
+        )
+        return 1
     slo = result["verdict"].get("slo")
     if slo is not None and not slo.get("met"):
         print(
@@ -873,6 +951,55 @@ def serve_http_main(argv) -> int:
     return 0
 
 
+def registry_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli registry {publish,list,resolve} ...``
+    — manage a versioned artifact registry (serve/registry.py): the
+    store blue/green hot-swaps resolve their targets from. ``publish``
+    copies an export artifact in as the next immutable version (its
+    digest chain verified first); ``list`` prints the index;
+    ``resolve`` digest-verifies one version and prints its path. Reads
+    and writes files only; never initializes a JAX backend."""
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli registry",
+        description="Versioned artifact registry for serving rollouts.",
+    )
+    ap.add_argument("action", choices=["publish", "list", "resolve"])
+    ap.add_argument(
+        "target", nargs="?", default="",
+        help="publish: the artifact dir; resolve: the version (vNNNN "
+        "or integer)",
+    )
+    ap.add_argument(
+        "-r", "--registry", required=True, help="registry root dir",
+    )
+    args = ap.parse_args(argv)
+
+    from bdbnn_tpu.serve.registry import ArtifactRegistry
+
+    reg = ArtifactRegistry(args.registry)
+    if args.action == "publish":
+        if not args.target:
+            ap.error("publish needs the artifact dir to publish")
+        entry = reg.publish(args.target)
+        print(json.dumps(entry, indent=2, sort_keys=True))
+        return 0
+    if args.action == "list":
+        print(json.dumps(reg.entries(), indent=2, sort_keys=True))
+        return 0
+    if not args.target:
+        ap.error("resolve needs a version (vNNNN or integer)")
+    from bdbnn_tpu.serve.registry import parse_version
+
+    try:
+        version = parse_version(args.target)
+    except ValueError as e:
+        ap.error(str(e))
+    print(reg.resolve(version))
+    return 0
+
+
 _SUBCOMMANDS = {
     "summarize": summarize_main,
     "watch": watch_main,
@@ -881,6 +1008,7 @@ _SUBCOMMANDS = {
     "predict": predict_main,
     "serve-bench": serve_bench_main,
     "serve-http": serve_http_main,
+    "registry": registry_main,
 }
 
 

@@ -263,6 +263,25 @@ class ServeBenchConfig:
     seed: int = 0
     out: str = ""  # also write the SLO verdict JSON here
     events_max_mb: float = 256.0
+    # replica pool (serve/pool.py): one AOT-warmed engine per mesh
+    # device behind the front batcher's async dispatch. More than one
+    # value = a scaling sweep: the bench runs once per N and the
+    # verdict gains the `scaling` block (throughput per N + the
+    # efficiency-at-max ratio `compare` judges).
+    replicas: Tuple[int, ...] = (1,)
+    # fabric mode: replace each replica's engine with a fixed
+    # pace_ms-per-batch sleep (nothing loads, nothing compiles) — on a
+    # CPU-simulated mesh every "device" shares one host's cores, so
+    # compute-bound throughput cannot scale with N regardless of the
+    # dispatcher; pacing measures what the POOL adds. 0 = real engines.
+    pace_ms: float = 0.0
+    # per-replica bounded queue, in BATCHES (the front batcher already
+    # bounds per-request queues; this bounds the dispatch fan-out)
+    replica_queue_batches: int = 8
+    # a replica busy on one batch longer than this is declared wedged:
+    # unhealthy -> routed around -> queued work re-dispatched -> worker
+    # restarted (serve/pool.py health monitor)
+    wedge_timeout_s: float = 30.0
 
     def validate(self) -> "ServeBenchConfig":
         if not self.artifact:
@@ -284,6 +303,16 @@ class ServeBenchConfig:
             raise ValueError("--max-delay-ms must be >= 0")
         if self.events_max_mb < 0:
             raise ValueError("--events-max-mb must be >= 0")
+        if not self.replicas or any(int(n) <= 0 for n in self.replicas):
+            raise ValueError(
+                f"--replicas must be positive ints, got {self.replicas!r}"
+            )
+        if self.pace_ms < 0:
+            raise ValueError("--pace-ms must be >= 0 (0 = real engines)")
+        if self.replica_queue_batches <= 0:
+            raise ValueError("--replica-queue-batches must be >= 1")
+        if self.wedge_timeout_s <= 0:
+            raise ValueError("--wedge-timeout-s must be > 0")
         return self
 
 
@@ -338,6 +367,30 @@ class ServeHttpConfig:
     stats_interval_s: float = 1.0  # cadence of live `http` stats events
     max_body_mb: float = 16.0
     events_max_mb: float = 256.0
+    # replica pool (serve/pool.py): N data-parallel engine replicas,
+    # one per mesh device, behind the front batcher. 1 = the classic
+    # single-engine path (a pool is still built when swap flags or a
+    # registry ask for one).
+    replicas: int = 1
+    # artifact registry root (serve/registry.py): enables
+    # POST /admin/swap {"version": N} and --swap-to vN resolution with
+    # digest verification. Empty = swap targets are artifact dirs.
+    registry: str = ""
+    # swap orchestration: the version (vNNNN / integer, with
+    # --registry) or artifact dir to hot-swap to. With --scenario,
+    # --swap-at FRAC fires the swap after that fraction of the
+    # schedule has been offered — the swap-under-load bench; without a
+    # scenario the swap can be driven externally via POST /admin/swap.
+    swap_to: str = ""
+    swap_at: float = 0.0
+    replica_queue_batches: int = 8
+    wedge_timeout_s: float = 30.0
+
+    @property
+    def pooled(self) -> bool:
+        """True when the serving path runs through a ReplicaPool: more
+        than one replica, a registry to swap from, or a swap target."""
+        return bool(self.replicas > 1 or self.registry or self.swap_to)
 
     def validate(self) -> "ServeHttpConfig":
         from bdbnn_tpu.serve.loadgen import SCENARIOS
@@ -420,4 +473,38 @@ class ServeHttpConfig:
                     f"--tenant-quota {tenant}: needs RATE >= 0 and "
                     f"BURST > 0, got {t_rate}:{t_burst}"
                 )
+        if self.replicas < 1:
+            raise ValueError("--replicas must be >= 1")
+        if not 0.0 <= self.swap_at < 1.0:
+            raise ValueError(
+                "--swap-at is a fraction of the scenario's offered "
+                f"requests in [0, 1), got {self.swap_at!r}"
+            )
+        if self.swap_at > 0 and not self.swap_to:
+            raise ValueError("--swap-at needs --swap-to (what to swap to)")
+        if self.swap_at > 0 and not self.scenario:
+            raise ValueError(
+                "--swap-at schedules a swap against a --scenario's "
+                "offered load; without one, drive POST /admin/swap "
+                "instead"
+            )
+        if self.scenario and self.swap_to and self.swap_at <= 0:
+            raise ValueError(
+                "--swap-to under a --scenario needs --swap-at FRAC "
+                "(when to fire it): a bench that silently never fires "
+                "the requested swap would exit 0 and read as a met "
+                "rollout contract"
+            )
+        if self.swap_at > 0 and self.replicas < 2:
+            raise ValueError(
+                "swap-under-load needs --replicas >= 2: the blue/green "
+                "shift takes the shifting replica out of the dispatch "
+                "set while peers absorb its load — with one replica "
+                "every batch assembled during the shift would shed, "
+                "failing the zero-shed gate by construction"
+            )
+        if self.replica_queue_batches <= 0:
+            raise ValueError("--replica-queue-batches must be >= 1")
+        if self.wedge_timeout_s <= 0:
+            raise ValueError("--wedge-timeout-s must be > 0")
         return self

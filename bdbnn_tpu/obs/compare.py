@@ -86,6 +86,14 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("serve_p99_ms_p2", "lower", "rel"),
     ("serve_fairness_ratio", "lower", "rel"),
     ("serve_tenant_shed_rate_max", "lower", "rel"),
+    # v3 (replica pool, serve/pool.py) verdicts: the --replicas sweep's
+    # scaling efficiency (throughput at N_max over ideal linear scaling
+    # from N_min — higher is better, --tol-rel) and the blue/green
+    # rollout's shed+dropped total under ZERO tolerance: a hot-swap
+    # that loses even one request is a regression no tolerance can
+    # wave through. v1/v2 verdicts leave both None (skipped).
+    ("serve_scaling_efficiency", "higher", "rel"),
+    ("serve_swap_dropped", "lower", "count"),
 )
 
 # serve-verdict field -> compare metric name (flat v1 aggregates)
@@ -121,6 +129,24 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
     out["serve_tenant_shed_rate_max"] = (
         max(shed_rates) if shed_rates else None
     )
+    # v3 blocks: replica-pool scaling + swap disposition
+    out["serve_scaling_efficiency"] = (
+        (verdict.get("scaling") or {}).get("efficiency")
+    )
+    swap = verdict.get("swap")
+    if swap is None:
+        out["serve_swap_dropped"] = None
+    else:
+        # everything a rollout may have cost: requests shed while the
+        # swap rolled plus client-observed drops on a swap run — the
+        # zero-tolerance number. A rollout that never COMPLETED counts
+        # as at least one lost unit: a failed swap must not score 0
+        # and slip past the gate just because traffic stayed on vN.
+        dropped = (verdict.get("client") or {}).get("dropped") or 0
+        not_performed = 0 if swap.get("performed") else 1
+        out["serve_swap_dropped"] = (
+            (swap.get("shed") or 0) + dropped + not_performed
+        )
     return out
 
 # the metric-key skeleton every extracted source carries (None = the

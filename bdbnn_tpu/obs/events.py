@@ -95,6 +95,26 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
   against the quotas that produced them), ``summary`` (final
   per-tenant admitted / over-quota / queue-shed / completed counters
   at drain — the per-tenant half of the SLO verdict)
+- ``replica``     — replica-pool lifecycle + heartbeat (serve/pool.py),
+  disambiguated by ``phase``: ``start`` (one per replica at pool
+  bring-up: replica id, device, version), ``unhealthy`` (the health
+  monitor declared a replica wedged or its worker dead: reason,
+  seconds stuck), ``restart`` (the replica was routed around, its
+  unstarted work re-dispatched — ``requeued``/``shed`` counts — and a
+  fresh worker spawned), ``monitor_error`` (the health loop survived
+  an internal error — recorded, never fatal), ``stats`` (periodic live
+  table: one row per replica with device / version / state / queue
+  depth / completed,
+  plus the completed-by-version ledger and the swap state — what
+  ``watch`` renders as the per-replica table)
+- ``swap``        — blue/green artifact rollout (serve/pool.py),
+  disambiguated by ``phase``: ``trigger`` (the swap-under-load
+  orchestration fired at a schedule position), ``start``
+  (version_from/version_to, replica count), ``warm`` (one standby
+  runner built + AOT-warmed, per replica), ``shift`` (one replica
+  drained its vN work and now serves vN+1), ``done`` (rollout
+  complete: seconds, replicas shifted), ``failed`` (the standby build
+  aborted — vN kept serving; error recorded)
 
 New kinds must be registered in :data:`KNOWN_KINDS` —
 ``tests/test_events_schema.py`` AST-scans every ``.emit(`` call site in
@@ -146,6 +166,8 @@ KNOWN_KINDS = frozenset(
         "serve",
         "http",
         "admission",
+        "replica",
+        "swap",
     }
 )
 
@@ -312,7 +334,21 @@ def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     serves = [e for e in events if e.get("kind") == "serve"]
     https = [e for e in events if e.get("kind") == "http"]
     admissions = [e for e in events if e.get("kind") == "admission"]
+    replicas = [e for e in events if e.get("kind") == "replica"]
+    swaps = [e for e in events if e.get("kind") == "swap"]
     return {
+        "replica_stats": next(
+            (
+                e for e in reversed(replicas)
+                if e.get("phase") == "stats"
+            ),
+            None,
+        ),
+        "replica_restarts": [
+            e for e in replicas if e.get("phase") == "restart"
+        ],
+        "swap_events": swaps,
+        "swap_last": swaps[-1] if swaps else None,
         "exports": exports,
         "start": next(
             (e for e in serves if e.get("phase") == "start"), None

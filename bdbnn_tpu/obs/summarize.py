@@ -342,11 +342,13 @@ def _serving(events) -> Optional[Dict[str, Any]]:
                           "requests_shed", "max_queue_depth_seen",
                           "max_queue", "preempted", "drained_clean",
                           "wall_s", "scenario", "per_priority",
-                          "per_tenant", "fairness_ratio", "slo")
+                          "per_tenant", "fairness_ratio", "slo",
+                          "replicas", "scaling", "swap")
             }
             if verdict
             else None
         ),
+        "replica_restarts": len(digest["replica_restarts"]),
     }
 
 
@@ -623,6 +625,65 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                     f"{slo.get('p99_ms_target_priority0')} ms — "
                     + ("MET" if slo.get("met") else "MISSED")
                 )
+            # the v3 replica-pool blocks: per-replica occupancy table,
+            # the --replicas scaling sweep, and the swap disposition
+            reps = sv.get("replicas")
+            if reps:
+                lines.append(
+                    f"  replicas: {reps.get('n')} on "
+                    f"{reps.get('version')} | "
+                    f"{reps.get('dispatched_batches')} batches "
+                    f"dispatched | {reps.get('restarts')} restart(s)"
+                )
+                for r in reps.get("per_replica") or []:
+                    lines.append(
+                        f"    r{r.get('replica')} "
+                        f"[{r.get('device')}] {r.get('version')}: "
+                        f"{r.get('completed')} done "
+                        f"({r.get('share'):.0%} share)"
+                        + (
+                            f", {r.get('restarts')} restart(s)"
+                            if r.get("restarts") else ""
+                        )
+                    )
+            scaling = sv.get("scaling")
+            if scaling:
+                lines.append(
+                    "  scaling: "
+                    + "  ".join(
+                        f"{n}x -> "
+                        f"{scaling['throughput_rps'].get(str(n))} rps"
+                        for n in scaling.get("replicas") or []
+                    )
+                    + f" | efficiency {scaling.get('efficiency')} at "
+                    f"{max(scaling.get('replicas') or [0])} replicas"
+                    + (
+                        "" if scaling.get("monotone")
+                        else " | NOT MONOTONE"
+                    )
+                )
+            swap = sv.get("swap")
+            if swap:
+                lines.append(
+                    f"  swap: {swap.get('version_from')} -> "
+                    f"{swap.get('version_to')} "
+                    + (
+                        f"DONE in {swap.get('seconds')}s"
+                        if swap.get("performed")
+                        else f"{swap.get('state')} "
+                        f"({swap.get('error')})"
+                    )
+                    + f" | {swap.get('replicas_shifted')} shifted | "
+                    f"shed during swap {swap.get('shed')}"
+                )
+                by = swap.get("answered_by") or {}
+                if by:
+                    lines.append(
+                        "    answered by: "
+                        + "  ".join(
+                            f"{v}: {n}" for v, n in sorted(by.items())
+                        )
+                    )
     if tta:
         lines.append("time-to-accuracy (val top-1):")
         for r in tta:

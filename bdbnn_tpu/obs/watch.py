@@ -73,6 +73,82 @@ def _serve_lines(events) -> List[str]:
                 else ""
             )
         )
+    replica_stats = digest["replica_stats"]
+    swap_last = digest["swap_last"]
+    if replica_stats and verdict is None:
+        # the live per-replica table: one row per replica — version,
+        # health state, queue depth, completed — plus the
+        # completed-by-version ledger once a swap has split it
+        lines.append(
+            f"replicas ({replica_stats.get('version')}): "
+            f"{replica_stats.get('completed')} done | "
+            f"{replica_stats.get('restarts')} restart(s)"
+        )
+        lines.append(
+            f"  {'id':<4} {'device':<14} {'version':<10} {'state':<10} "
+            f"{'queue':>5} {'done':>8}"
+        )
+        for r in replica_stats.get("replicas") or []:
+            lines.append(
+                f"  {r.get('replica'):<4} {str(r.get('device')):<14} "
+                f"{str(r.get('version')):<10} {str(r.get('state')):<10} "
+                f"{r.get('queue_depth'):>5} {r.get('completed'):>8}"
+            )
+        by_version = replica_stats.get("completed_by_version") or {}
+        if len(by_version) > 1:
+            lines.append(
+                "  answered by: "
+                + "  ".join(
+                    f"{v}: {n}" for v, n in sorted(by_version.items())
+                )
+            )
+    if swap_last and verdict is None:
+        phase = swap_last.get("phase")
+        if phase == "trigger" and swap_last.get("status") not in (
+            None, 202,
+        ):
+            # a rejected scheduled trigger (400/404/409) is TERMINAL
+            # for THIS trigger — no start/failed event ever follows it,
+            # so an in-progress banner here would stick for the rest of
+            # the run. But a 409 can mean a swap is ALREADY in flight
+            # (operator-initiated), so only the other statuses may
+            # claim no rollout is running.
+            status = swap_last.get("status")
+            tail = (
+                "this trigger started nothing (another rollout "
+                "may be mid-flight)"
+                if status == 409 else "no rollout is running"
+            )
+            lines.append(
+                f"!! swap trigger REJECTED (HTTP {status}): "
+                f"{swap_last.get('error')} — {tail}"
+            )
+        elif phase in ("trigger", "start", "warm", "shift"):
+            progress = ""
+            swap_state = (replica_stats or {}).get("swap") or {}
+            if swap_state.get("replicas_total"):
+                progress = (
+                    f" [{swap_state.get('replicas_shifted', 0)}/"
+                    f"{swap_state.get('replicas_total')} shifted]"
+                )
+            lines.append(
+                f">> SWAP in progress: "
+                f"{swap_last.get('version_from') or '...'} -> "
+                f"{swap_last.get('version_to')}{progress} "
+                f"(phase {phase}) — traffic keeps flowing"
+            )
+        elif phase == "done":
+            lines.append(
+                f"swap: {swap_last.get('version_from')} -> "
+                f"{swap_last.get('version_to')} DONE in "
+                f"{swap_last.get('seconds')}s "
+                f"({swap_last.get('replicas_shifted')} replicas)"
+            )
+        elif phase == "failed":
+            lines.append(
+                f"!! swap to {swap_last.get('version_to')} FAILED "
+                f"({swap_last.get('error')}) — old version kept serving"
+            )
     if http_stats and verdict is None:
         s = http_stats[-1]
         age = time.time() - float(s.get("t", time.time()))
@@ -132,6 +208,43 @@ def _serve_lines(events) -> List[str]:
         fr = verdict.get("fairness_ratio")
         if fr is not None:
             lines.append(f"  fairness: max/min tenant service {fr}")
+        replicas = verdict.get("replicas")
+        if replicas:
+            lines.append(
+                f"  replicas: {replicas.get('n')} on "
+                f"{replicas.get('version')} | "
+                f"{replicas.get('restarts')} restart(s) | shares "
+                + " ".join(
+                    f"r{r.get('replica')}:{r.get('share'):.0%}"
+                    for r in replicas.get("per_replica") or []
+                )
+            )
+        scaling = verdict.get("scaling")
+        if scaling:
+            lines.append(
+                "  scaling: "
+                + " -> ".join(
+                    f"{n}x {scaling['throughput_rps'].get(str(n))}rps"
+                    for n in scaling.get("replicas") or []
+                )
+                + f" | efficiency {scaling.get('efficiency')}"
+                + ("" if scaling.get("monotone") else " | NOT MONOTONE")
+            )
+        swap = verdict.get("swap")
+        if swap:
+            lines.append(
+                f"  swap: {swap.get('version_from')} -> "
+                f"{swap.get('version_to')} "
+                + ("DONE" if swap.get("performed")
+                   else f"{swap.get('state')}")
+                + f" | shed {swap.get('shed')} | answered by "
+                + "  ".join(
+                    f"{v}: {n}"
+                    for v, n in sorted(
+                        (swap.get("answered_by") or {}).items()
+                    )
+                )
+            )
     return lines
 
 

@@ -227,6 +227,38 @@ def broadcast_host_int(value: int) -> int:
     )
 
 
+def replica_devices(
+    n: int, mesh: Optional[Mesh] = None
+) -> Sequence[jax.Device]:
+    """The ``n`` devices a serving replica pool places its engines on
+    (one engine per device — data parallelism for inference, the serve
+    counterpart of the 'data' mesh axis).
+
+    With a mesh, replicas take the data axis's device order (one
+    replica per data-parallel row, cycling through model-parallel
+    columns only if ``n`` exceeds the rows — a replica should own a
+    whole model shard group before doubling up). Without one, the flat
+    ``jax.devices()`` order. ``n`` beyond the device count is an
+    error: two replicas contending for one chip is a silent perf lie,
+    not a bigger pool."""
+    if n <= 0:
+        raise ValueError(f"need n >= 1 replicas, got {n}")
+    if mesh is not None:
+        arr = np.asarray(mesh.devices)
+        # data-major order: walk rows (data axis) first, then columns
+        flat = list(arr.T.reshape(-1)) if arr.ndim == 2 else list(
+            arr.reshape(-1)
+        )
+    else:
+        flat = list(jax.devices())
+    if n > len(flat):
+        raise ValueError(
+            f"{n} replicas over {len(flat)} devices: one engine per "
+            "device is the contract (shrink --replicas or grow the mesh)"
+        )
+    return flat[:n]
+
+
 def jit_train_step(step_fn) -> Any:
     """Compile a train step for mesh execution.
 

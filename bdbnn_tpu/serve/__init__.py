@@ -25,7 +25,17 @@ Training's other half. Four modules, composing bottom-up:
   asyncio HTTP/1.1 over the batcher with priority classes
   (``x-priority`` header → per-class bounded queues), per-tenant
   admission control (429 vs 503), /healthz + /readyz wired to AOT
-  warmup + the drain latch, and the ``serve-http`` orchestration
+  warmup + the drain latch, the ``/admin`` replica/swap operator
+  routes, and the ``serve-http`` orchestration
+- :mod:`bdbnn_tpu.serve.pool`     — the replica pool: one AOT-warmed
+  engine per mesh device behind a least-loaded dispatcher with
+  per-replica bounded queues, wedge detection + routed-around
+  restarts, and zero-downtime blue/green artifact hot-swap
+  (stdlib-only; engines injected)
+- :mod:`bdbnn_tpu.serve.registry` — the versioned artifact registry:
+  immutable published versions with a verified digest chain
+  (index → artifact.json → weights.npz) + provenance, the store swap
+  targets resolve from
 
 CLI surface: ``export`` / ``predict`` / ``serve-bench`` /
 ``serve-http`` (``bdbnn_tpu.cli``). Import of this package root stays
@@ -45,6 +55,13 @@ from bdbnn_tpu.serve.export import (
     read_artifact,
 )
 from bdbnn_tpu.serve.http import HttpFrontEnd, run_serve_http
+from bdbnn_tpu.serve.pool import (
+    PoolAdmin,
+    Replica,
+    ReplicaPool,
+    make_engine_runner_factory,
+)
+from bdbnn_tpu.serve.registry import ArtifactRegistry
 from bdbnn_tpu.serve.loadgen import (
     SCENARIOS,
     VERDICT_NAME,
@@ -62,13 +79,18 @@ __all__ = [
     "VERDICT_NAME",
     "WEIGHTS_NAME",
     "AdmissionController",
+    "ArtifactRegistry",
     "HttpFrontEnd",
     "HttpLoadGenerator",
     "LoadGenerator",
     "LoadShedError",
     "MicroBatcher",
+    "PoolAdmin",
+    "Replica",
+    "ReplicaPool",
     "TokenBucket",
     "build_schedule",
+    "make_engine_runner_factory",
     "export_artifact",
     "load_artifact_variables",
     "percentile",

@@ -63,8 +63,16 @@ class MicroBatcher:
 
     ``runner(batch_list) -> results`` receives the payloads of one
     coalesced batch and returns one result per payload (any indexable).
-    ``on_batch(stats_dict)`` (optional) fires after every executed
-    batch — the serve CLIs use it to emit ``serve`` events.
+    A runner may instead return a ``concurrent.futures.Future``
+    resolving to the results (**async dispatch** — the replica-pool
+    path, serve/pool.py): the worker chains the per-request futures to
+    it and immediately collects the NEXT batch, so N pool replicas
+    execute batches concurrently instead of serializing behind one
+    blocking runner call. Completion accounting moves to the chained
+    callback; :meth:`drain` additionally waits for every dispatched
+    batch to resolve, so the no-unresolved-Future guarantee holds in
+    both modes. ``on_batch(stats_dict)`` (optional) fires after every
+    executed batch — the serve CLIs use it to emit ``serve`` events.
 
     ``priorities`` (default 1) sets the number of priority classes;
     ``submit(payload, priority=p)`` with ``0 <= p < priorities``
@@ -83,11 +91,14 @@ class MicroBatcher:
         max_delay_ms: float = 5.0,
         on_batch: Optional[Callable[[Dict[str, Any]], None]] = None,
         priorities: int = 1,
+        max_pending_batches: Optional[int] = None,
     ):
         if max_batch <= 0 or max_queue <= 0:
             raise ValueError("max_batch and max_queue must be positive")
         if priorities <= 0:
             raise ValueError("priorities must be >= 1")
+        if max_pending_batches is not None and max_pending_batches <= 0:
+            raise ValueError("max_pending_batches must be >= 1")
         self.runner = runner
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
@@ -116,6 +127,20 @@ class MicroBatcher:
         self._completed_p = [0] * self.priorities
         self._max_depth_p = [0] * self.priorities
         self._occupancy_sum_p = [0.0] * self.priorities
+        # async-dispatched batches (runner returned a Future) not yet
+        # resolved — drain() waits for this to hit zero.
+        # max_pending_batches is the async-mode BACKPRESSURE bound: the
+        # worker stops assembling new batches while this many are
+        # outstanding, so requests wait in the per-priority FRONT
+        # queues (where strict-priority dequeue still applies) instead
+        # of FIFO-ing into downstream replica queues — and an overload
+        # sheds at submit() like the blocking path, never by failing
+        # batches that were already accepted. The pool orchestrations
+        # set it to ~2x the replica count: one batch executing + one
+        # queued per replica, bounding priority inversion to what is
+        # already dispatched.
+        self._pending_async = 0
+        self.max_pending_batches = max_pending_batches
         self._thread = threading.Thread(
             target=self._worker, name="micro-batcher", daemon=True
         )
@@ -167,12 +192,28 @@ class MicroBatcher:
 
         The no-unresolved-Future guarantee is enforced by the worker's
         exit protocol (final queue sweep + ``_dead`` latch under the
-        submit lock, see :meth:`_worker`), not by timing here."""
+        submit lock, see :meth:`_worker`), not by timing here — plus,
+        in async-dispatch mode, by waiting out every batch Future the
+        runner handed back (the pool resolves them as it drains)."""
         self._draining.set()
         with self._cv:
             self._cv.notify_all()  # wake a worker parked on an empty queue
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
         self._thread.join(timeout)
-        return not self._thread.is_alive()
+        clean = not self._thread.is_alive()
+        with self._cv:
+            while self._pending_async > 0:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            clean = clean and self._pending_async == 0
+        return clean
 
     @property
     def draining(self) -> bool:
@@ -256,6 +297,15 @@ class MicroBatcher:
 
     def _worker(self) -> None:
         while True:
+            if self.max_pending_batches is not None:
+                # async backpressure: hold off assembling the next batch
+                # until the pool has headroom. This holds THROUGH drain
+                # too — the pool keeps resolving batches, pending falls,
+                # and every queued request is dispatched in (priority)
+                # order rather than shed against a full replica queue.
+                with self._cv:
+                    while self._pending_async >= self.max_pending_batches:
+                        self._cv.wait(timeout=0.02)
             batch = self._collect()
             if not batch:
                 # drain exit: latch _dead and sweep stragglers ATOMICALLY
@@ -284,50 +334,82 @@ class MicroBatcher:
                     if not r.future.done():
                         r.future.set_exception(e)
                 continue
-            t1 = time.monotonic()
-            for i, r in enumerate(batch):
-                # done() guard: a client may have cancel()ed its Future
-                # (set_result would raise InvalidStateError); a runner
-                # returning too few results must fail THAT future, not
-                # kill the worker thread for good
-                try:
-                    if not r.future.done():
-                        r.future.set_result(results[i])
-                except Exception as e:
-                    if not r.future.done():
-                        r.future.set_exception(e)
-            with self._cv:
-                per_prio_n = [0] * self.priorities
-                for r in batch:
-                    per_prio_n[r.priority] += 1
-                self.completed += len(batch)
-                self.batches += 1
-                self.occupancy_sum += len(batch) / self.max_batch
-                for p in range(self.priorities):
-                    self._completed_p[p] += per_prio_n[p]
-                    self._occupancy_sum_p[p] += (
-                        per_prio_n[p] / self.max_batch
-                    )
-                stats = {
-                    "batch_size": len(batch),
-                    "occupancy": round(len(batch) / self.max_batch, 4),
-                    "queue_depth": sum(len(q) for q in self._qs),
-                    "queue_depth_by_priority": [
-                        len(q) for q in self._qs
-                    ],
-                    "batch_by_priority": per_prio_n,
-                    "run_ms": round((t1 - t0) * 1000.0, 3),
-                    "oldest_wait_ms": round(
-                        (t0 - batch[0].t_enqueue) * 1000.0, 3
-                    ),
-                    "completed": self.completed,
-                    "shed": self.shed,
-                }
-            if self.on_batch is not None:
-                try:
-                    self.on_batch(stats)
-                except Exception:
-                    pass  # telemetry must never kill the serving loop
+            if isinstance(results, Future):
+                # async dispatch (replica pool): chain settlement to the
+                # batch Future and collect the NEXT batch immediately —
+                # this is what lets N replicas run concurrently behind
+                # one front batcher
+                with self._cv:
+                    self._pending_async += 1
+
+                def _chain(f: Future, batch=batch, t0=t0):
+                    try:
+                        exc = None if f.cancelled() else f.exception()
+                        if f.cancelled() or exc is not None:
+                            e = exc or LoadShedError("draining")
+                            for r in batch:
+                                if not r.future.done():
+                                    r.future.set_exception(e)
+                        else:
+                            self._settle(
+                                batch, f.result(), t0, time.monotonic()
+                            )
+                    finally:
+                        with self._cv:
+                            self._pending_async -= 1
+                            self._cv.notify_all()
+
+                results.add_done_callback(_chain)
+                continue
+            self._settle(batch, results, t0, time.monotonic())
+
+    def _settle(self, batch, results, t0: float, t1: float) -> None:
+        """Distribute one executed batch's results and account it —
+        shared by the synchronous runner path and the async-dispatch
+        callback."""
+        for i, r in enumerate(batch):
+            # done() guard: a client may have cancel()ed its Future
+            # (set_result would raise InvalidStateError); a runner
+            # returning too few results must fail THAT future, not
+            # kill the worker thread for good
+            try:
+                if not r.future.done():
+                    r.future.set_result(results[i])
+            except Exception as e:
+                if not r.future.done():
+                    r.future.set_exception(e)
+        with self._cv:
+            per_prio_n = [0] * self.priorities
+            for r in batch:
+                per_prio_n[r.priority] += 1
+            self.completed += len(batch)
+            self.batches += 1
+            self.occupancy_sum += len(batch) / self.max_batch
+            for p in range(self.priorities):
+                self._completed_p[p] += per_prio_n[p]
+                self._occupancy_sum_p[p] += (
+                    per_prio_n[p] / self.max_batch
+                )
+            stats = {
+                "batch_size": len(batch),
+                "occupancy": round(len(batch) / self.max_batch, 4),
+                "queue_depth": sum(len(q) for q in self._qs),
+                "queue_depth_by_priority": [
+                    len(q) for q in self._qs
+                ],
+                "batch_by_priority": per_prio_n,
+                "run_ms": round((t1 - t0) * 1000.0, 3),
+                "oldest_wait_ms": round(
+                    (t0 - batch[0].t_enqueue) * 1000.0, 3
+                ),
+                "completed": self.completed,
+                "shed": self.shed,
+            }
+        if self.on_batch is not None:
+            try:
+                self.on_batch(stats)
+            except Exception:
+                pass  # telemetry must never kill the serving loop
 
 
 __all__ = ["LoadShedError", "MicroBatcher"]

@@ -40,6 +40,7 @@ class InferenceEngine:
         *,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         warm: bool = True,
+        device: Optional[Any] = None,
     ):
         from bdbnn_tpu.models.registry import create_model
         from bdbnn_tpu.serve.export import (
@@ -68,9 +69,13 @@ class InferenceEngine:
             ),
         )
         # weights go to device once; every compiled bucket closes over
-        # the same placed copies
+        # the same placed copies. An explicit device pins this engine
+        # to ONE mesh device — the replica-pool path (serve/pool.py)
+        # places one engine per device so N replicas execute on N chips
+        # instead of contending for the default one.
+        self.device = device
         self._variables = jax.device_put(
-            load_artifact_variables(artifact_dir)
+            load_artifact_variables(artifact_dir), device
         )
         self._compiled: Dict[int, Any] = {}
         self.compile_seconds: Dict[int, float] = {}
@@ -98,9 +103,20 @@ class InferenceEngine:
             if b in self._compiled:
                 continue
             t0 = time.perf_counter()
-            zeros = jax.ShapeDtypeStruct(
-                (b, self.image_size, self.image_size, 3), np.float32
-            )
+            # a device-pinned engine lowers its input spec with the
+            # device's sharding, so the compiled executable lives on
+            # (and accepts numpy inputs transferred to) THAT device
+            if self.device is not None:
+                from jax.sharding import SingleDeviceSharding
+
+                zeros = jax.ShapeDtypeStruct(
+                    (b, self.image_size, self.image_size, 3), np.float32,
+                    sharding=SingleDeviceSharding(self.device),
+                )
+            else:
+                zeros = jax.ShapeDtypeStruct(
+                    (b, self.image_size, self.image_size, 3), np.float32
+                )
             self._compiled[b] = (
                 jax.jit(self._apply).lower(self._variables, zeros).compile()
             )

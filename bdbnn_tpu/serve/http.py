@@ -83,6 +83,18 @@ def _default_decode(body: bytes, content_type: str) -> Any:
     return json.loads(body) if body else None
 
 
+def _shed_key(reason: str) -> str:
+    """Ledger column for a LoadShedError reason — the taxonomy every
+    shed site (submit-time and future-delivered alike) buckets through,
+    so a total pool outage ("no healthy replica") is never misfiled as
+    queue-full backpressure and triage reads the right layer."""
+    if reason == "draining":
+        return "shed_draining"
+    if reason == "no healthy replica":
+        return "shed_unavailable"
+    return "shed_queue_full"
+
+
 def _default_encode(result: Any) -> Any:
     return jsonsafe(result)
 
@@ -111,6 +123,7 @@ class HttpFrontEnd:
         max_body_bytes: int = 16 * 2**20,
         default_priority: Optional[int] = None,
         retry_after_s: int = 1,
+        admin: Optional[Any] = None,
     ):
         self.batcher = batcher
         self.admission = admission
@@ -128,6 +141,10 @@ class HttpFrontEnd:
             else int(default_priority)
         )
         self.retry_after_s = int(retry_after_s)
+        # the replica-pool operator surface (serve/pool.py:PoolAdmin):
+        # GET /admin/replicas, GET/POST /admin/swap. None = the admin
+        # routes 404 (single-engine serving has no pool to administer).
+        self.admin = admin
         self._draining = threading.Event()
         # in-flight = /v1/predict handlers between request-parsed and
         # response-written; open connections additionally tracked in
@@ -144,7 +161,7 @@ class HttpFrontEnd:
         self._counts_by_priority: List[Dict[str, int]] = [
             {"submitted": 0, "completed": 0, "failed": 0,
              "rejected": 0, "shed_draining": 0, "shed_over_quota": 0,
-             "shed_queue_full": 0}
+             "shed_queue_full": 0, "shed_unavailable": 0}
             for _ in range(batcher.priorities)
         ]
         self._requests_seen = 0
@@ -378,8 +395,56 @@ class HttpFrontEnd:
                 self._respond(writer, 200, {"state": "ready"})
         elif method == "GET" and path == "/statsz":
             self._respond(writer, 200, self.stats())
+        elif path in ("/admin/replicas", "/admin/swap"):
+            await self._admin(writer, method, path, body)
         elif method == "POST" and path == PREDICT_PATH:
             await self._predict(writer, headers, body)
+        else:
+            self._respond(
+                writer, 404, {"error": f"no route {method} {path}"}
+            )
+
+    async def _admin(self, writer, method, path, body) -> None:
+        """The replica-pool operator routes. ``GET /admin/replicas`` =
+        the live per-replica table (device, version, health, queue
+        depth, completed); ``GET /admin/swap`` = the swap state
+        machine's status; ``POST /admin/swap`` with ``{"version": N}``
+        (registry, digest-verified) or ``{"artifact": "/dir"}`` starts
+        a blue/green rollout and returns 202 while traffic keeps
+        flowing — the zero-downtime contract is the pool's, the route
+        only triggers it."""
+        if self.admin is None:
+            self._respond(writer, 404, {
+                "error": "no replica pool behind this server "
+                "(started without --replicas/--registry)",
+            })
+            return
+        if method == "GET" and path == "/admin/replicas":
+            self._respond(writer, 200, self.admin.replicas())
+        elif method == "GET" and path == "/admin/swap":
+            self._respond(writer, 200, self.admin.swap_status())
+        elif method == "POST" and path == "/admin/swap":
+            try:
+                spec = json.loads(body) if body else {}
+            except Exception as e:
+                self._respond(
+                    writer, 400, {"error": f"undecodable body: {e}"}
+                )
+                return
+            if not isinstance(spec, dict):
+                self._respond(
+                    writer, 400,
+                    {"error": "swap body must be a JSON object"},
+                )
+                return
+            # off the event loop: start_swap digest-verifies the target
+            # (hashes the weights payload) before spawning the rollout
+            # thread — run inline it would stall every in-flight
+            # connection for the duration, spiking p99 exactly at the
+            # "zero-downtime" trigger
+            status, payload = await asyncio.get_event_loop(
+            ).run_in_executor(None, self.admin.start_swap, spec)
+            self._respond(writer, status, payload)
         else:
             self._respond(
                 writer, 404, {"error": f"no route {method} {path}"}
@@ -466,11 +531,7 @@ class HttpFrontEnd:
             fut = self.batcher.submit(payload, priority=priority)
         except LoadShedError as e:
             self.admission.record_shed(tenant)
-            key = (
-                "shed_draining" if e.reason == "draining"
-                else "shed_queue_full"
-            )
-            counts[key] += 1
+            counts[_shed_key(e.reason)] += 1
             self._respond(
                 writer, 503,
                 {"error": e.reason, "tenant": tenant},
@@ -480,12 +541,14 @@ class HttpFrontEnd:
         try:
             result = await asyncio.wrap_future(fut)
         except LoadShedError as e:
-            # a drain latched between submit and execution can in
-            # principle never strand a queued request (drain waits
-            # for in-flight first) — but belt and braces: it is
-            # still an explicit shed, never a dropped connection
+            # a shed can land on the FUTURE too: the pooled runner
+            # raises inside the batcher worker when every replica
+            # queue is full (or none is healthy), and a drain latched
+            # between submit and execution is the belt-and-braces
+            # case — either way an explicit shed, never a dropped
+            # connection, ledgered under its real reason
             self.admission.record_shed(tenant)
-            counts["shed_draining"] += 1
+            counts[_shed_key(e.reason)] += 1
             self._respond(
                 writer, 503,
                 {"error": e.reason, "tenant": tenant},
@@ -536,7 +599,7 @@ class HttpFrontEnd:
             ],
             "shed_by_priority": [
                 c["shed_draining"] + c["shed_over_quota"]
-                + c["shed_queue_full"]
+                + c["shed_queue_full"] + c["shed_unavailable"]
                 for c in self._counts_by_priority
             ],
         })
@@ -603,17 +666,52 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
     from bdbnn_tpu.serve.admission import parse_quota, parse_tenant_quotas
     from bdbnn_tpu.serve.engine import InferenceEngine
     from bdbnn_tpu.serve.loadgen import (
-        VERDICT_NAME,
         HttpLoadGenerator,
+        _ArtifactMeta,
         _pct,
+        _pool_replicas_block,
+        _serve_provenance,
         build_schedule,
         http_slo_verdict,
+        write_verdict_files,
     )
+
+    # registry resolution: with --registry, the ARTIFACT argument may
+    # name a published version (v0003 / 3) — resolved with digest
+    # verification instead of trusted as a path
+    registry = None
+    artifact_dir = cfg.artifact
+    version_label = None
+    if cfg.registry:
+        from bdbnn_tpu.serve.registry import (
+            ArtifactRegistry,
+            looks_like_version,
+            parse_version,
+        )
+
+        registry = ArtifactRegistry(cfg.registry)
+        if looks_like_version(cfg.artifact or ""):
+            version = parse_version(cfg.artifact)
+            artifact_dir = registry.resolve(version)
+            version_label = registry.label(version)
+    if version_label is None:
+        version_label = (
+            os.path.basename(artifact_dir.rstrip(os.sep)) or "live"
+        )
 
     # engine cold: the server comes up immediately with /healthz 200 +
     # /readyz 503 "warming", flipping ready only when the AOT buckets
-    # are compiled — the load balancer sees the real warmup state
-    engine = InferenceEngine(cfg.artifact, buckets=cfg.buckets, warm=False)
+    # are compiled — the load balancer sees the real warmup state. The
+    # pooled path needs METADATA only (per-device replica engines are
+    # built and warmed after the listener binds) — loading a full
+    # weight copy here would pin a dead resident set on the default
+    # device for the server's whole life.
+    if cfg.pooled:
+        engine: Any = _ArtifactMeta(artifact_dir, cfg.buckets)
+    else:
+        engine = InferenceEngine(
+            artifact_dir, buckets=cfg.buckets, warm=False
+        )
 
     stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
     run_dir = os.path.join(cfg.log_path, stamp)
@@ -624,7 +722,7 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         run_dir,
         {
             "mode": "serve-http",
-            "artifact": os.path.abspath(cfg.artifact),
+            "artifact": os.path.abspath(artifact_dir),
             # recipe fields flow through so `compare` aligns serving
             # runs on the same export provenance (None entries dropped,
             # spread FIRST — see serve-bench)
@@ -642,6 +740,11 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             "seed": cfg.seed,
             "default_quota": cfg.default_quota,
             "tenant_quotas": list(cfg.tenant_quotas),
+            "replicas": cfg.replicas,
+            "registry": os.path.abspath(cfg.registry) if cfg.registry
+            else None,
+            "swap_to": cfg.swap_to or None,
+            "swap_at": cfg.swap_at or None,
         },
     )
     events = EventWriter(run_dir, max_bytes=int(cfg.events_max_mb * 2**20))
@@ -693,8 +796,29 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
                 shed=stats["shed"],
             )
 
-    def runner(samples: List[np.ndarray]):
-        return engine.predict_logits(np.stack(samples))
+    # one runner slot, two shapes: the classic single engine (blocking
+    # call) or the replica pool's async dispatch (the runner returns
+    # the batch Future; the batcher chains it and keeps collecting, so
+    # N replicas execute concurrently). The pool is built AFTER the
+    # listener binds — pool_ref carries it in.
+    pool_ref: List[Any] = []
+
+    if cfg.pooled:
+
+        def runner(samples: List[np.ndarray]):
+            if not pool_ref:
+                # a predict raced the replica warmup (readyz is still
+                # 503 "warming" — a well-behaved LB isn't routing yet):
+                # explicit shed, never a hang. "no healthy replica" so
+                # the ledger files it as shed_unavailable — a server
+                # with zero load must not read as queue-full overload
+                raise LoadShedError("no healthy replica")
+            return pool_ref[0].submit(samples)
+
+    else:
+
+        def runner(samples: List[np.ndarray]):
+            return engine.predict_logits(np.stack(samples))
 
     batcher = MicroBatcher(
         runner,
@@ -703,6 +827,11 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         max_delay_ms=cfg.max_delay_ms,
         on_batch=on_batch,
         priorities=cfg.priorities,
+        # async backpressure on the pooled path (~1 executing + 1
+        # queued batch per replica): overload sheds at the front's
+        # per-priority queues, and priority inversion is bounded to
+        # the batches already dispatched
+        max_pending_batches=2 * cfg.replicas if cfg.pooled else None,
     )
 
     shape = (engine.image_size, engine.image_size, 3)
@@ -729,10 +858,14 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             "logits": [round(float(x), 4) for x in np.asarray(logits)],
         }
 
+    ready_fn = (
+        (lambda: bool(pool_ref)) if cfg.pooled
+        else (lambda: engine.warmed)
+    )
     front = HttpFrontEnd(
         batcher,
         admission,
-        ready_fn=lambda: engine.warmed,
+        ready_fn=ready_fn,
         decode=decode,
         encode=encode,
         host=cfg.host,
@@ -745,7 +878,7 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         phase="start",
         host=host,
         port=port,
-        artifact=os.path.abspath(cfg.artifact),
+        artifact=os.path.abspath(artifact_dir),
         arch=engine.arch,
         buckets=list(engine.buckets),
         priorities=cfg.priorities,
@@ -754,11 +887,57 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         scenario=cfg.scenario or None,
         rate_rps=cfg.rate if cfg.scenario else None,
         requests=cfg.requests if cfg.scenario else None,
+        replicas=cfg.replicas if cfg.pooled else None,
+        version=version_label if cfg.pooled else None,
     )
-    warmup_s = engine.warmup()  # readyz flips 200 when this returns
+    admin = None
+    if cfg.pooled:
+        # build the replica set: one engine per mesh device, AOT-warmed
+        # by the factory — readyz stays 503 "warming" until the whole
+        # set is resident, then flips
+        from bdbnn_tpu.parallel.mesh import replica_devices
+        from bdbnn_tpu.serve.pool import (
+            PoolAdmin,
+            ReplicaPool,
+            first_warm_capture,
+            make_engine_runner_factory,
+            replica_stats_fields,
+        )
+
+        warm_compile, _on_engine = first_warm_capture()
+        factory = make_engine_runner_factory(
+            cfg.buckets, on_engine=_on_engine
+        )
+        pool = ReplicaPool(
+            factory,
+            list(replica_devices(cfg.replicas)),
+            artifact_ref=artifact_dir,
+            version=version_label,
+            max_queue_batches=cfg.replica_queue_batches,
+            wedge_timeout_s=cfg.wedge_timeout_s,
+            on_event=lambda kind, **f: events.emit(kind, **f),
+        )
+        pool_ref.append(pool)  # readyz flips 200 from here
+        admin = PoolAdmin(
+            pool,
+            registry=registry,
+            # "shed caused during the swap window" across BOTH layers:
+            # the front batcher's per-class queues and the pool's
+            # replica queues — both in REQUEST units (the pool also
+            # counts shed batches, a different unit)
+            shed_counter=lambda: (
+                batcher.stats()["shed"] + pool.stats()["shed_requests"]
+            ),
+        )
+        front.admin = admin
+        warmup_s = dict(warm_compile)
+    else:
+        pool = None
+        warmup_s = engine.warmup()  # readyz flips 200 when this returns
     events.emit(
         "http", phase="ready", warmup_compile_s=dict(warmup_s),
         host=host, port=port,
+        replicas=cfg.replicas if cfg.pooled else None,
     )
 
     # periodic live-state events: per-priority depths, per-tenant
@@ -788,6 +967,12 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
                     for t, c in s["admission"]["tenants"].items()
                 },
             )
+            if pool is not None:
+                # the live per-replica heartbeat `watch` renders
+                events.emit(
+                    "replica", phase="stats",
+                    **replica_stats_fields(pool.stats()),
+                )
 
     pump = threading.Thread(target=stats_pump, daemon=True)
     pump.start()
@@ -796,8 +981,8 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
     try:
         if cfg.scenario:
             rng = np.random.default_rng(cfg.seed)
-            pool = rng.standard_normal((32, *shape)).astype(np.float32)
-            bodies = [np.ascontiguousarray(x).tobytes() for x in pool]
+            img_pool = rng.standard_normal((32, *shape)).astype(np.float32)
+            bodies = [np.ascontiguousarray(x).tobytes() for x in img_pool]
             schedule = build_schedule(
                 cfg.scenario,
                 requests=cfg.requests,
@@ -818,6 +1003,64 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
                 heavy_sigma=cfg.heavy_sigma,
                 slow_fraction=cfg.slow_fraction,
             )
+            # swap-under-load: after --swap-at of the schedule has been
+            # OFFERED, fire the same blue/green rollout the admin
+            # endpoint exposes — the bench then proves zero dropped and
+            # zero shed-due-to-swap under this scenario's pressure
+            on_arrival = None
+            if cfg.swap_at > 0 and admin is not None:
+                threshold = max(int(cfg.swap_at * len(schedule)), 1)
+                swap_fired: List[bool] = []
+                from bdbnn_tpu.serve.registry import (
+                    looks_like_version,
+                    parse_version,
+                )
+
+                if registry is not None and looks_like_version(
+                    cfg.swap_to
+                ):
+                    swap_spec: Dict[str, Any] = {
+                        "version": parse_version(cfg.swap_to)
+                    }
+                else:
+                    swap_spec = {"artifact": cfg.swap_to}
+
+                def on_arrival(i: int) -> None:
+                    if not swap_fired and i + 1 >= threshold:
+                        swap_fired.append(True)
+
+                        # fire OFF the arrival-scheduling thread:
+                        # start_swap digest-verifies the target
+                        # (hashes weights.npz) before returning, and a
+                        # stall here would offer every later arrival
+                        # late — inflating exactly the latencies the
+                        # swap-under-load bench exists to measure
+                        def _fire(at=i + 1):
+                            status, payload = admin.start_swap(
+                                swap_spec
+                            )
+                            if status != 202:
+                                # a rejected SCHEDULED swap must land
+                                # in the verdict as not-performed — a
+                                # bad --swap-to exiting 0 would read
+                                # as a met rollout contract
+                                admin.note_request_failed(
+                                    cfg.swap_to, payload.get("error")
+                                )
+                            events.emit(
+                                "swap",
+                                phase="trigger",
+                                at_request=at,
+                                of=len(schedule),
+                                status=status,
+                                **payload,
+                            )
+
+                        threading.Thread(
+                            target=_fire, name="swap-trigger",
+                            daemon=True,
+                        ).start()
+
             gen = HttpLoadGenerator(
                 host,
                 port,
@@ -827,6 +1070,7 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
                 stop_fn=lambda: handler.preempted,
                 slow_chunks=cfg.slow_chunks,
                 slow_gap_s=cfg.slow_gap_ms / 1000.0,
+                on_arrival=on_arrival,
             )
             client_raw = gen.run()
         else:
@@ -841,6 +1085,12 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             preempted=preempted,
         )
         drained_clean = front.drain(timeout=120.0)
+        if admin is not None:
+            # let an in-flight rollout settle before the pool winds
+            # down — its report belongs in the verdict either way
+            admin.wait(timeout=30.0)
+        if pool is not None:
+            drained_clean = pool.drain(timeout=60.0) and drained_clean
         stats_stop.set()
         pump.join(timeout=5.0)
 
@@ -855,29 +1105,24 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         # would fabricate an offered-load figure nothing measured
         rate=cfg.rate if cfg.scenario else None,
         seed=cfg.seed,
-        provenance={
-            "artifact": os.path.abspath(cfg.artifact),
-            "arch": engine.arch,
-            "dataset": engine.dataset,
-            "config_hash": prov.get("config_hash"),
-            "recipe": recipe,
-            "serve_config_hash": manifest.get("config_hash"),
-        },
+        provenance=_serve_provenance(
+            artifact_dir, engine, prov, recipe, manifest
+        ),
         warmup_s=warmup_s,
         preempted=preempted,
         drained_clean=drained_clean,
         client=client_raw,
         slo_p99_ms=cfg.slo_p99_ms,
+        replicas=(
+            _pool_replicas_block(pool.stats()) if pool is not None
+            else None
+        ),
+        swap=admin.swap_report() if admin is not None else None,
     )
     events.emit("serve", phase="verdict", **verdict)
     events.emit("http", phase="stop", host=host, port=port)
     events.close()
-    for out in (os.path.join(run_dir, VERDICT_NAME), cfg.out or None):
-        if out:
-            tmp = out + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(verdict, f, indent=2, sort_keys=True)
-            os.replace(tmp, out)
+    write_verdict_files(verdict, run_dir, cfg.out)
     return {
         "verdict": verdict,
         "run_dir": run_dir,

@@ -62,8 +62,15 @@ from bdbnn_tpu.serve.batching import LoadShedError, MicroBatcher
 VERDICT_NAME = "verdict.json"
 # v2: per-priority latency blocks, per-tenant shed rates, fairness
 # ratio and the scenario name joined the verdict (serve/http.py); v1
-# aggregate fields are unchanged, so v1 consumers keep working
-VERDICT_SCHEMA_VERSION = 2
+# aggregate fields are unchanged, so v1 consumers keep working.
+# v3: the replica-pool blocks (serve/pool.py) — ``replicas``
+# (per-replica device/version/occupancy/restart table), ``scaling``
+# (the serve-bench --replicas sweep: throughput per N + the
+# efficiency-at-max ratio compare judges) and ``swap`` (blue/green
+# rollout disposition: versions, shed-due-to-swap, completed-by-
+# version ledger). All three are null on single-replica runs, so v1/v2
+# consumers keep working unchanged.
+VERDICT_SCHEMA_VERSION = 3
 
 
 def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -437,6 +444,7 @@ class HttpLoadGenerator:
         slow_chunks: int = 4,
         slow_gap_s: float = 0.02,
         timeout_s: float = 60.0,
+        on_arrival: Optional[Callable[[int], None]] = None,
     ):
         self.host = host
         self.port = int(port)
@@ -449,6 +457,9 @@ class HttpLoadGenerator:
         self.slow_chunks = max(int(slow_chunks), 1)
         self.slow_gap_s = float(slow_gap_s)
         self.timeout_s = float(timeout_s)
+        # fires with the schedule index after each arrival is offered —
+        # the swap-under-load orchestration keys its trigger off it
+        self.on_arrival = on_arrival
         self._lock = threading.Lock()
         self.by_status: Dict[int, int] = {}
         self.dropped = 0
@@ -560,6 +571,11 @@ class HttpLoadGenerator:
                 self.submitted += 1
             # latency clock starts at the SCHEDULED arrival
             work.put((i, arr, t0 + arr.t))
+            if self.on_arrival is not None:
+                try:
+                    self.on_arrival(i)
+                except Exception:
+                    pass  # an orchestration hook must not stop the load
         for _ in workers:
             work.put(None)
         for w in workers:
@@ -629,6 +645,9 @@ def slo_verdict(
     fairness: Optional[float] = None,
     client: Optional[Dict[str, Any]] = None,
     slo: Optional[Dict[str, Any]] = None,
+    replicas: Optional[Dict[str, Any]] = None,
+    scaling: Optional[Dict[str, Any]] = None,
+    swap: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble the deterministic strict-JSON SLO verdict.
 
@@ -639,7 +658,10 @@ def slo_verdict(
     per tenant), ``fairness_ratio`` (max/min per-tenant service rate),
     ``client`` (the socket load generator's own observation — the
     zero-dropped cross-check) and ``slo`` (a target judged at verdict
-    time)."""
+    time). The replica pool (serve/pool.py) adds the v3 blocks:
+    ``replicas`` (the per-replica table + completed-by-version
+    ledger), ``scaling`` (the --replicas sweep summary) and ``swap``
+    (blue/green rollout disposition)."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
@@ -673,6 +695,9 @@ def slo_verdict(
         "fairness_ratio": fairness,
         "client": client,
         "slo": slo,
+        "replicas": replicas,
+        "scaling": scaling,
+        "swap": swap,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (
@@ -701,6 +726,8 @@ def http_slo_verdict(
     drained_clean: bool = True,
     client: Optional[Dict[str, Any]] = None,
     slo_p99_ms: float = 0.0,
+    replicas: Optional[Dict[str, Any]] = None,
+    swap: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Build the v2 verdict from the HTTP front end's request ledger
     (:meth:`serve.http.HttpFrontEnd.accounting`), the batcher's
@@ -715,6 +742,7 @@ def http_slo_verdict(
         shed = (
             counts["shed_draining"] + counts["shed_over_quota"]
             + counts["shed_queue_full"]
+            + counts.get("shed_unavailable", 0)
         )
         per_priority[str(p)] = {
             "submitted": counts["submitted"],
@@ -725,6 +753,7 @@ def http_slo_verdict(
             "shed_draining": counts["shed_draining"],
             "shed_over_quota": counts["shed_over_quota"],
             "shed_queue_full": counts["shed_queue_full"],
+            "shed_unavailable": counts.get("shed_unavailable", 0),
             "shed_rate": round(
                 shed / max(counts["submitted"], 1), 6
             ),
@@ -785,6 +814,8 @@ def http_slo_verdict(
         fairness=fairness_ratio(per_tenant),
         client=client,
         slo=slo,
+        replicas=replicas,
+        swap=swap,
     )
 
 
@@ -806,6 +837,352 @@ def run_serve_bench(cfg) -> Dict[str, Any]:
 
 
 def _serve_bench_body(cfg, handler) -> Dict[str, Any]:
+    """Route one serve-bench invocation: the classic single-engine path
+    for the default config, the replica-pool path (optionally a
+    multi-N scaling sweep) when ``--replicas`` asks for more than one
+    replica — or for the paced fabric mode either way."""
+    sweep = tuple(sorted({int(n) for n in cfg.replicas}))
+    if sweep == (1,) and cfg.pace_ms == 0:
+        return _serve_bench_single(cfg, handler)
+    return _serve_bench_pool(cfg, handler, sweep)
+
+
+class _ArtifactMeta:
+    """Just the artifact metadata the pooled orchestrations need —
+    arch/dataset/shape/buckets read from ``artifact.json``, with NO
+    weight load and NO device placement (the serving weights live
+    inside the replicas' own engines; paced fabric mode loads nothing
+    at all). Duck-types the fields the manifest/provenance helpers
+    read off a real engine."""
+
+    def __init__(self, artifact_dir: str, buckets):
+        from bdbnn_tpu.serve.export import read_artifact
+
+        self.artifact = read_artifact(artifact_dir)
+        self.arch = self.artifact["arch"]
+        self.dataset = self.artifact["dataset"]
+        self.image_size = int(self.artifact["image_size"])
+        self.num_classes = int(self.artifact["num_classes"])
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+
+
+def _bench_manifest_fields(cfg, engine, prov, recipe) -> Dict[str, Any]:
+    """The manifest fields both serve-bench paths (single-engine and
+    replica-pool) share — one place for the provenance/knob surface, so
+    a new field cannot land in one path and drift from the other."""
+    return {
+        "mode": "serve-bench",
+        "artifact": os.path.abspath(cfg.artifact),
+        # recipe fields flow through so `compare` aligns serving runs
+        # on the same export provenance — None entries dropped and
+        # spread FIRST, so a bare-checkpoint export's empty recipe can
+        # never null out the arch/dataset the engine positively knows
+        **{k: v for k, v in recipe.items() if v is not None},
+        "arch": engine.arch,
+        "dataset": engine.dataset,
+        "export_config_hash": prov.get("config_hash"),
+        "buckets": list(cfg.buckets),
+        "queue_depth": cfg.queue_depth,
+        "max_delay_ms": cfg.max_delay_ms,
+        "load_mode": cfg.mode,
+        "rate": cfg.rate,
+        "requests": cfg.requests,
+        "concurrency": cfg.concurrency,
+        "seed": cfg.seed,
+    }
+
+
+def _serve_provenance(
+    artifact_dir, engine, prov, recipe, manifest
+) -> Dict[str, Any]:
+    """The verdict's provenance block — shared by both bench paths and
+    the HTTP front end (whose ``artifact_dir`` may be a
+    registry-resolved version, not the raw CLI argument)."""
+    return {
+        "artifact": os.path.abspath(artifact_dir),
+        "arch": engine.arch,
+        "dataset": engine.dataset,
+        "config_hash": prov.get("config_hash"),
+        "recipe": recipe,
+        "serve_config_hash": manifest.get("config_hash"),
+    }
+
+
+def write_verdict_files(
+    verdict: Dict[str, Any], run_dir: str, out: str = ""
+) -> None:
+    """Atomically (tmp + rename) write the verdict to the run dir and,
+    when set, the caller's ``--out`` path — the one write protocol all
+    three serving orchestrations use."""
+    for path in (os.path.join(run_dir, VERDICT_NAME), out or None):
+        if path:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(verdict, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+
+
+def _pool_replicas_block(
+    pool_stats: Optional[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """The verdict's v3 ``replicas`` block from one pool's final
+    :meth:`~bdbnn_tpu.serve.pool.ReplicaPool.stats` snapshot: the
+    per-replica table (device, version, batches, completed requests,
+    occupancy share, restarts) and the completed-by-version ledger the
+    swap acceptance test pins."""
+    if pool_stats is None:
+        return None
+    total = max(pool_stats["completed"], 1)
+    return {
+        "n": pool_stats["n_replicas"],
+        "version": pool_stats["version"],
+        "dispatched_batches": pool_stats["dispatched"],
+        "pool_shed_batches": pool_stats["shed"],
+        "restarts": pool_stats["restarts"],
+        "completed_by_version": pool_stats["completed_by_version"],
+        "per_replica": [
+            {
+                "replica": r["replica"],
+                "device": r["device"],
+                "version": r["version"],
+                "state": r["state"],
+                "batches": r["batches"],
+                "completed": r["completed"],
+                # occupancy share: this replica's slice of the served
+                # requests — a wedged/unhealthy replica shows up as a
+                # hole here even when the aggregate throughput held
+                "share": round(r["completed"] / total, 4),
+                "restarts": r["restarts"],
+            }
+            for r in pool_stats["replicas"]
+        ],
+    }
+
+
+def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
+    """The replica-pool serve-bench: for each N in ``sweep`` build an
+    N-replica pool (one AOT-warmed engine per mesh device — or a paced
+    stub per simulated device in fabric mode), drive the configured
+    load through the front batcher's async dispatch, and drain. With
+    more than one N the verdict carries the ``scaling`` block
+    (throughput per N, monotonicity, efficiency at the largest N =
+    throughput(N_max) / ((N_max/N_min) * throughput(N_min)) — the
+    number ``compare`` judges as ``serve_scaling_efficiency``)."""
+    import datetime
+
+    import numpy as np
+
+    from bdbnn_tpu.obs.events import EventWriter
+    from bdbnn_tpu.obs.manifest import write_manifest
+    from bdbnn_tpu.serve.pool import (
+        ReplicaPool,
+        first_warm_capture,
+        make_engine_runner_factory,
+        replica_stats_fields,
+    )
+
+    paced = cfg.pace_ms > 0
+    # metadata/shape source only (no weight load, no device_put) —
+    # replica engines are built and AOT-warmed per device by the
+    # factory; paced mode loads nothing at all
+    engine = _ArtifactMeta(cfg.artifact, cfg.buckets)
+
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+    run_dir = os.path.join(cfg.log_path, stamp)
+    os.makedirs(run_dir, exist_ok=True)
+    prov = engine.artifact.get("provenance", {})
+    recipe = prov.get("recipe") or {}
+    manifest = write_manifest(
+        run_dir,
+        {
+            **_bench_manifest_fields(cfg, engine, prov, recipe),
+            "replicas": list(sweep),
+            "pace_ms": cfg.pace_ms,
+        },
+    )
+    events = EventWriter(run_dir, max_bytes=int(cfg.events_max_mb * 2**20))
+    events.emit(
+        "serve",
+        phase="start",
+        artifact=os.path.abspath(cfg.artifact),
+        arch=engine.arch,
+        buckets=list(cfg.buckets),
+        mode=cfg.mode,
+        rate_rps=cfg.rate if cfg.mode == "open" else None,
+        requests=cfg.requests,
+        queue_depth=cfg.queue_depth,
+        max_delay_ms=cfg.max_delay_ms,
+        replicas=list(sweep),
+        pace_ms=cfg.pace_ms if paced else None,
+    )
+
+    warm_compile, _on_engine = first_warm_capture()
+    factory = make_engine_runner_factory(
+        cfg.buckets,
+        pace_ms=cfg.pace_ms,
+        on_engine=_on_engine,
+    )
+    rng = np.random.default_rng(cfg.seed)
+    img_pool = rng.standard_normal(
+        (32, engine.image_size, engine.image_size, 3)
+    ).astype(np.float32)
+    sample_fn = lambda i: img_pool[i % len(img_pool)]
+
+    throughput: Dict[str, float] = {}
+    passes: Dict[int, Any] = {}
+    for n in sweep:
+        if handler.preempted:
+            break
+        if paced:
+            devices: List[Any] = [f"paced:{i}" for i in range(n)]
+        else:
+            from bdbnn_tpu.parallel.mesh import replica_devices
+
+            devices = list(replica_devices(n))
+        pool = ReplicaPool(
+            factory,
+            devices,
+            artifact_ref=cfg.artifact,
+            version="v0001",
+            max_queue_batches=cfg.replica_queue_batches,
+            wedge_timeout_s=cfg.wedge_timeout_s,
+            on_event=lambda kind, **f: events.emit(kind, **f),
+        )
+
+        # live telemetry parity with the single-engine path: rolling
+        # per-batch `serve` stats (on_batch fires from the async
+        # settle callback too) + the per-replica heartbeat `watch`
+        # renders — a pooled bench must not go dark while it runs
+        window: List[float] = []
+        win_lock = threading.Lock()
+        batch_counter = [0]
+        emit_every = max(
+            cfg.requests // (20 * max(engine.buckets[-1], 1)), 1
+        )
+
+        def on_batch(stats: Dict[str, Any], n=n) -> None:
+            with win_lock:
+                window.append(stats["oldest_wait_ms"] + stats["run_ms"])
+                del window[:-256]
+                rolling = sorted(window)
+                batch_counter[0] += 1
+                nb = batch_counter[0]
+            if nb % emit_every == 0:
+                events.emit(
+                    "serve",
+                    phase="stats",
+                    replicas_n=n,
+                    batch_size=stats["batch_size"],
+                    occupancy=stats["occupancy"],
+                    queue_depth=stats["queue_depth"],
+                    rolling_p99_ms=_pct(rolling, 99.0),
+                    completed=stats["completed"],
+                    shed=stats["shed"],
+                )
+
+        pump_stop = threading.Event()
+
+        def pump(pool=pool):
+            while not pump_stop.wait(0.5):
+                events.emit(
+                    "replica", phase="stats",
+                    **replica_stats_fields(pool.stats()),
+                )
+
+        t_pump = threading.Thread(
+            target=pump, name="bench-replica-stats", daemon=True
+        )
+        t_pump.start()
+
+        batcher = MicroBatcher(
+            pool.submit,
+            max_batch=engine.buckets[-1],
+            max_queue=cfg.queue_depth,
+            max_delay_ms=cfg.max_delay_ms,
+            on_batch=on_batch,
+            # backpressure: ~1 executing + 1 queued batch per replica —
+            # overload sheds at the front (priority-ordered), never by
+            # failing accepted batches against full replica queues
+            max_pending_batches=2 * n,
+        )
+        gen = LoadGenerator(
+            batcher.submit,
+            sample_fn,
+            mode=cfg.mode,
+            requests=cfg.requests,
+            rate=cfg.rate,
+            concurrency=cfg.concurrency,
+            seed=cfg.seed,
+            stop_fn=lambda: handler.preempted,
+        )
+        raw = gen.run()
+        drained = batcher.drain(timeout=120.0)
+        drained = pool.drain(timeout=60.0) and drained
+        pump_stop.set()
+        t_pump.join(timeout=5.0)
+        thr = round(raw["completed"] / max(raw["wall_s"], 1e-9), 3)
+        throughput[str(n)] = thr
+        passes[n] = (raw, batcher.stats(), pool.stats(), drained)
+        events.emit(
+            "serve",
+            phase="scaling",
+            replicas_n=n,
+            throughput_rps=thr,
+            completed=raw["completed"],
+            shed=raw["shed"],
+            wall_s=round(raw["wall_s"], 3),
+        )
+
+    if passes:
+        n_last = max(passes)
+        raw, batcher_stats, pool_stats, drained_clean = passes[n_last]
+    else:
+        # preempted before the first pass could offer load: an honest
+        # empty verdict, drained by construction
+        raw = {"submitted": 0, "completed": 0, "shed": 0, "failed": 0,
+               "wall_s": 0.0, "latencies_ms": []}
+        batcher_stats, pool_stats, drained_clean = {}, None, True
+
+    scaling = None
+    if len(passes) > 1:
+        ns = sorted(passes)
+        n_min, n_max = ns[0], ns[-1]
+        t_min, t_max = throughput[str(n_min)], throughput[str(n_max)]
+        vals = [throughput[str(n)] for n in ns]
+        scaling = {
+            "replicas": ns,
+            "throughput_rps": throughput,
+            # ideal scaling from the smallest measured N: 1.0 = linear
+            "efficiency": (
+                round(t_max / ((n_max / n_min) * t_min), 4)
+                if t_min else None
+            ),
+            "monotone": all(b >= a for a, b in zip(vals, vals[1:])),
+            "paced_ms": cfg.pace_ms if paced else None,
+        }
+
+    verdict = slo_verdict(
+        raw,
+        batcher_stats,
+        mode=cfg.mode,
+        rate=cfg.rate,
+        seed=cfg.seed,
+        provenance=_serve_provenance(
+            cfg.artifact, engine, prov, recipe, manifest
+        ),
+        warmup_s=dict(warm_compile) if warm_compile else None,
+        preempted=handler.preempted,
+        drained_clean=drained_clean,
+        replicas=_pool_replicas_block(pool_stats),
+        scaling=scaling,
+    )
+    events.emit("serve", phase="verdict", **verdict)
+    events.close()
+    write_verdict_files(verdict, run_dir, cfg.out)
+    return {"verdict": verdict, "run_dir": run_dir}
+
+
+def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
     import datetime
 
     import numpy as np
@@ -823,28 +1200,7 @@ def _serve_bench_body(cfg, handler) -> Dict[str, Any]:
     prov = engine.artifact.get("provenance", {})
     recipe = prov.get("recipe") or {}
     manifest = write_manifest(
-        run_dir,
-        {
-            "mode": "serve-bench",
-            "artifact": os.path.abspath(cfg.artifact),
-            # recipe fields flow through so `compare` aligns serving
-            # runs on the same export provenance — None entries dropped
-            # and spread FIRST, so a bare-checkpoint export's empty
-            # recipe can never null out the arch/dataset the engine
-            # positively knows
-            **{k: v for k, v in recipe.items() if v is not None},
-            "arch": engine.arch,
-            "dataset": engine.dataset,
-            "export_config_hash": prov.get("config_hash"),
-            "buckets": list(cfg.buckets),
-            "queue_depth": cfg.queue_depth,
-            "max_delay_ms": cfg.max_delay_ms,
-            "load_mode": cfg.mode,
-            "rate": cfg.rate,
-            "requests": cfg.requests,
-            "concurrency": cfg.concurrency,
-            "seed": cfg.seed,
-        },
+        run_dir, _bench_manifest_fields(cfg, engine, prov, recipe)
     )
     events = EventWriter(
         run_dir, max_bytes=int(cfg.events_max_mb * 2**20)
@@ -933,26 +1289,16 @@ def _serve_bench_body(cfg, handler) -> Dict[str, Any]:
         mode=cfg.mode,
         rate=cfg.rate,
         seed=cfg.seed,
-        provenance={
-            "artifact": os.path.abspath(cfg.artifact),
-            "arch": engine.arch,
-            "dataset": engine.dataset,
-            "config_hash": prov.get("config_hash"),
-            "recipe": recipe,
-            "serve_config_hash": manifest.get("config_hash"),
-        },
+        provenance=_serve_provenance(
+            cfg.artifact, engine, prov, recipe, manifest
+        ),
         warmup_s=warmup_s,
         preempted=preempted,
         drained_clean=drained_clean,
     )
     events.emit("serve", phase="verdict", **verdict)
     events.close()
-    for out in (os.path.join(run_dir, VERDICT_NAME), cfg.out or None):
-        if out:
-            tmp = out + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(verdict, f, indent=2, sort_keys=True)
-            os.replace(tmp, out)
+    write_verdict_files(verdict, run_dir, cfg.out)
     return {"verdict": verdict, "run_dir": run_dir}
 
 
@@ -969,4 +1315,5 @@ __all__ = [
     "percentile",
     "run_serve_bench",
     "slo_verdict",
+    "write_verdict_files",
 ]

@@ -1,0 +1,985 @@
+"""Replica pool: data-parallel serving over the device mesh + hot swap.
+
+One engine on one device serves one device's worth of traffic. This
+module fans the serving stack out: one :class:`Replica` per mesh device
+(each owning its own AOT-warmed engine, bounded work queue and worker
+thread) behind one dispatcher, so the front micro-batcher's coalesced
+batches execute on N devices concurrently. Four responsibilities:
+
+1. **Dispatch** — :meth:`ReplicaPool.submit` places one coalesced batch
+   on the least-loaded READY replica (queue depth + busy flag). The
+   batcher already dequeued strict-priority, so batch ARRIVAL order
+   preserves priority; least-loaded placement preserves it across
+   replicas (no batch waits behind a deep queue while another replica
+   idles). Per-replica queues are bounded: when every candidate is
+   full, ``submit`` raises :class:`LoadShedError` — the same explicit
+   rejection contract as the front batcher, one layer down.
+2. **Health** — a monitor thread watches per-replica heartbeats: a
+   worker wedged in its runner past ``wedge_timeout_s`` (or a dead
+   worker thread) marks the replica UNHEALTHY, its queued (unstarted)
+   batches are re-dispatched to healthy peers, and a fresh worker is
+   spawned (generation-tagged, so the wedged thread retires itself
+   when — if — its stuck call returns, and its in-flight batch is still
+   ANSWERED, never dropped). The dispatcher routes around unhealthy
+   replicas the whole time.
+3. **Blue/green swap** — :meth:`swap` rolls the pool onto a new
+   artifact version with zero requests dropped and zero shed caused by
+   the swap itself: the new version's runners are ALL built and
+   AOT-warmed first (the standby set — cheap, because 1-bit + alpha
+   artifacts are ~7x smaller than dense weights), then traffic shifts
+   replica-by-replica (shifting replica leaves the dispatch set, its
+   accepted work finishes on vN, its runner pointer swaps, it rejoins
+   serving vN+1) while the rest of the pool absorbs the load. Every
+   request is answered by exactly one version; the pool ledger records
+   which (``completed_by_version``).
+4. **Drain** — the PR 5/7 latched-flag contract one layer down: after
+   :meth:`drain` no batch enters a replica queue, every queued batch is
+   executed and answered, then workers exit.
+
+Stdlib-only: runners are injected callables (the real path binds
+:class:`bdbnn_tpu.serve.engine.InferenceEngine` instances placed on
+their mesh devices via :func:`make_engine_runner_factory`), so the
+dispatcher, health and swap machinery — and their tests — never need a
+JAX backend. Telemetry flows through an injected ``on_event`` hook
+(``replica`` and ``swap`` event kinds, obs/events.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from bdbnn_tpu.serve.batching import LoadShedError
+
+# replica states: dispatchable is READY only
+WARMING = "warming"
+READY = "ready"
+SHIFTING = "shifting"  # blue/green: leaving the dispatch set to swap
+UNHEALTHY = "unhealthy"
+STOPPED = "stopped"
+
+# swap states (the admin endpoint's status machine)
+SWAP_IDLE = "idle"
+SWAP_WARMING = "warming"
+SWAP_SHIFTING = "shifting"
+SWAP_DONE = "done"
+SWAP_FAILED = "failed"
+
+
+class _Work:
+    __slots__ = ("payloads", "future", "t_enqueue")
+
+    def __init__(self, payloads):
+        self.payloads = payloads
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+
+
+class Replica:
+    """One engine's worth of serving capacity: a bounded batch queue and
+    a worker thread executing ``runner(payloads) -> results`` — with a
+    heartbeat (``busy_since``) the pool's health monitor reads."""
+
+    def __init__(
+        self,
+        rid: int,
+        runner: Callable[[List[Any]], Any],
+        *,
+        device: str = "",
+        version: str = "v0",
+        max_queue_batches: int = 8,
+    ):
+        self.rid = int(rid)
+        self.device = str(device)
+        self.version = str(version)
+        self.max_queue_batches = int(max_queue_batches)
+        self._runner = runner
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q: deque = deque()
+        self.state = READY
+        # monotonic timestamp of the batch currently executing (None =
+        # idle) — the wedge detector's heartbeat
+        self.busy_since: Optional[float] = None
+        # generation tag: a restart bumps it; a worker observing a
+        # newer generation retires itself instead of double-consuming
+        self._gen = 0
+        self.batches = 0
+        self.completed = 0
+        self.restarts = 0
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        # superseded worker threads that were still alive at restart: a
+        # wedged generation may hold an accepted batch Future, and
+        # stop() must wait it out (or report unclean) — dropping the
+        # reference would let drain() claim clean with the Future
+        # unresolved
+        self._retired_threads: List[threading.Thread] = []
+        self._on_done: Optional[Callable[["Replica", int, str], None]] = None
+        self.start_worker()
+
+    # -- worker --------------------------------------------------------
+
+    def start_worker(self) -> None:
+        with self._lock:
+            self._gen += 1
+            gen = self._gen
+            self._stopping = False
+            # the heartbeat belongs to the NEW generation: a wedged old
+            # worker's stale busy_since must not re-trip the monitor
+            # (it retires itself when its stuck call returns)
+            self.busy_since = None
+        if self._thread is not None and self._thread.is_alive():
+            self._retired_threads = [
+                t for t in self._retired_threads if t.is_alive()
+            ]
+            self._retired_threads.append(self._thread)
+        self._thread = threading.Thread(
+            target=self._worker, args=(gen,),
+            name=f"replica-{self.rid}", daemon=True,
+        )
+        self._thread.start()
+
+    def _worker(self, gen: int) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopping and self._gen == gen:
+                    self._cv.wait(timeout=0.05)
+                if self._gen != gen:
+                    return  # superseded by a restart
+                if self._stopping and not self._q:
+                    return
+                work = self._q.popleft()
+                self.busy_since = time.monotonic()
+                # the version label this batch executes under is fixed
+                # at pickup: a concurrent swap must not relabel it
+                version = self.version
+                runner = self._runner
+            try:
+                results = runner(work.payloads)
+            except Exception as e:
+                with self._cv:
+                    if self._gen == gen:
+                        self.busy_since = None
+                if not work.future.done():
+                    work.future.set_exception(e)
+                continue
+            retired = False
+            with self._cv:
+                if self._gen == gen:
+                    self.busy_since = None
+                else:
+                    retired = True
+                # a retiring (superseded) worker's answered batch still
+                # counts: it WAS served by this replica, and the
+                # per-replica table must agree with the
+                # completed-by-version ledger _on_done feeds
+                self.batches += 1
+                self.completed += len(work.payloads)
+            if not work.future.done():
+                work.future.set_result(results)
+            if self._on_done is not None:
+                try:
+                    self._on_done(self, len(work.payloads), version)
+                except Exception:
+                    pass  # ledger hooks must never kill a worker
+            if retired:
+                return  # a wedged worker's last act: answer, then exit
+
+    # -- pool-side surface (all called under pool coordination) --------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def load(self) -> int:
+        with self._lock:
+            return len(self._q) + (1 if self.busy_since is not None else 0)
+
+    def try_enqueue(self, work: _Work) -> bool:
+        with self._cv:
+            if self.state != READY or self._stopping:
+                return False
+            if len(self._q) >= self.max_queue_batches:
+                return False
+            self._q.append(work)
+            self._cv.notify()
+            return True
+
+    def take_queued(self) -> List[_Work]:
+        """Strip the UNSTARTED queue (requeue path: unhealthy replica's
+        pending work moves to healthy peers)."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._q and self.busy_since is None
+
+    def worker_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def wedged(self, timeout_s: float) -> bool:
+        with self._lock:
+            return (
+                self.busy_since is not None
+                and time.monotonic() - self.busy_since > timeout_s
+            )
+
+    def swap_runner(self, runner, version: str) -> None:
+        with self._lock:
+            self._runner = runner
+            self.version = str(version)
+
+    def stop(self, timeout: Optional[float] = None) -> bool:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        clean = True
+        threads = [t for t in [self._thread] if t is not None]
+        threads += self._retired_threads
+        for t in threads:
+            t.join(
+                None if deadline is None
+                else max(deadline - time.monotonic(), 0.0)
+            )
+            clean = clean and not t.is_alive()
+        return clean
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replica": self.rid,
+                "device": self.device,
+                "version": self.version,
+                "state": self.state,
+                "queue_depth": len(self._q),
+                "busy": self.busy_since is not None,
+                "batches": self.batches,
+                "completed": self.completed,
+                "restarts": self.restarts,
+                "max_queue_batches": self.max_queue_batches,
+            }
+
+
+class ReplicaPool:
+    """N replicas behind one least-loaded dispatcher, with health
+    monitoring and blue/green artifact swap.
+
+    ``runner_factory(artifact_ref, device) -> runner`` builds one
+    replica's batch callable (the real factory AOT-warms an engine on
+    that device — see :func:`make_engine_runner_factory`); ``devices``
+    names one replica per entry (device labels are opaque strings here;
+    the jax Device objects live inside the factory's closure).
+    ``on_event(kind, **fields)`` receives ``replica``/``swap``
+    telemetry when wired.
+    """
+
+    def __init__(
+        self,
+        runner_factory: Callable[[Any, str], Callable[[List[Any]], Any]],
+        devices: Sequence[str],
+        *,
+        artifact_ref: Any = None,
+        version: str = "v0001",
+        max_queue_batches: int = 8,
+        wedge_timeout_s: float = 30.0,
+        health_interval_s: float = 0.25,
+        on_event: Optional[Callable[..., Any]] = None,
+    ):
+        if not devices:
+            raise ValueError("a replica pool needs at least one device")
+        if max_queue_batches <= 0:
+            raise ValueError("max_queue_batches must be >= 1")
+        self.runner_factory = runner_factory
+        self.artifact_ref = artifact_ref
+        self.version = str(version)
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.on_event = on_event
+        self._lock = threading.Lock()
+        self._draining = threading.Event()
+        # two units on purpose: `shed` counts BATCHES (the thing submit
+        # rejects), `shed_requests` the requests inside them — swap
+        # reporting and the verdict's request ledger read the latter so
+        # they never mix units with the front batcher's per-request
+        # counters
+        self.shed = 0
+        self.shed_requests = 0
+        self.dispatched = 0
+        self.completed_by_version: Dict[str, int] = {}
+        self._swap_lock = threading.Lock()
+        self._swap_status: Dict[str, Any] = {"state": SWAP_IDLE}
+        # the factory needs the REAL device objects (jax.Device on the
+        # engine path); replica snapshots carry only the string label
+        self._device_objs: List[Any] = list(devices)
+        self.replicas: List[Replica] = []
+        for rid, dev in enumerate(devices):
+            r = Replica(
+                rid,
+                runner_factory(artifact_ref, dev),
+                device=str(dev),
+                version=self.version,
+                max_queue_batches=max_queue_batches,
+            )
+            r._on_done = self._record_done
+            self.replicas.append(r)
+            self._emit(
+                "replica", phase="start", replica=rid, device=str(dev),
+                version=self.version,
+            )
+        self._monitor_stop = threading.Event()
+        self._monitor = threading.Thread(
+            target=self._health_loop, args=(float(health_interval_s),),
+            name="replica-health", daemon=True,
+        )
+        self._monitor.start()
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(kind, **fields)
+            except Exception:
+                pass  # telemetry must never take the pool down
+
+    def _record_done(self, replica: Replica, n: int, version: str) -> None:
+        with self._lock:
+            self.completed_by_version[version] = (
+                self.completed_by_version.get(version, 0) + n
+            )
+
+    # -- dispatch ------------------------------------------------------
+
+    def _place(self, work: _Work) -> Optional[bool]:
+        """THE dispatch policy, shared by submit and the restart
+        requeue path: least-loaded READY replica first, then the rest
+        (a candidate can fill between the load read and the enqueue,
+        so try in order). True = enqueued; False = every candidate
+        full; None = no READY replica at all."""
+        candidates = sorted(
+            (r for r in self.replicas if r.state == READY),
+            key=lambda r: r.load(),
+        )
+        for r in candidates:
+            if r.try_enqueue(work):
+                return True
+        return False if candidates else None
+
+    def submit(self, payloads: List[Any]) -> Future:
+        """Place one coalesced batch on the least-loaded READY replica;
+        returns the batch Future (one result list for the whole batch —
+        exactly what the micro-batcher's async runner contract wants).
+        Raises :class:`LoadShedError` when draining, when no replica is
+        healthy, or when every healthy replica's queue is full."""
+        if self._draining.is_set():
+            with self._lock:
+                self.shed += 1
+                self.shed_requests += len(payloads)
+            raise LoadShedError("draining")
+        work = _Work(payloads)
+        placed = self._place(work)
+        if placed:
+            with self._lock:
+                self.dispatched += 1
+            return work.future
+        with self._lock:
+            self.shed += 1
+            self.shed_requests += len(payloads)
+        raise LoadShedError(
+            "queue full" if placed is False else "no healthy replica"
+        )
+
+    # -- health --------------------------------------------------------
+
+    def _health_loop(self, interval_s: float) -> None:
+        while not self._monitor_stop.wait(interval_s):
+            for r in self.replicas:
+                try:
+                    if r.state not in (READY, SHIFTING):
+                        continue
+                    dead = not r.worker_alive()
+                    wedged = r.wedged(self.wedge_timeout_s)
+                    if dead or wedged:
+                        self._restart_replica(
+                            r, "worker died" if dead else "wedged"
+                        )
+                except Exception as e:
+                    # the monitor is the thing that notices broken
+                    # replicas — it must never die of one; record the
+                    # miss and keep watching
+                    self._emit(
+                        "replica", phase="monitor_error",
+                        replica=r.rid, error=str(e),
+                    )
+
+    def _restart_replica(self, r: Replica, reason: str) -> None:
+        if self._draining.is_set():
+            # drain owns the replicas now: restarting one here would
+            # resurrect a worker (start_worker resets _stopping) that
+            # drain already stopped and will never join
+            return
+        # a SHIFTING replica stays out of the dispatch set after its
+        # restart (the swap loop owns bringing it back READY) —
+        # clobbering it READY would re-admit traffic to the replica the
+        # shift is waiting to drain
+        with r._lock:
+            prior = r.state
+            r.state = UNHEALTHY
+            busy = r.busy_since
+        self._emit(
+            "replica", phase="unhealthy", replica=r.rid, device=r.device,
+            version=r.version, reason=reason,
+            busy_s=(
+                round(time.monotonic() - busy, 3)
+                if busy is not None else None
+            ),
+        )
+        # unstarted work moves to healthy peers (the wedged batch
+        # itself is answered by the retiring worker when it unsticks)
+        requeued = shed = 0
+        for work in r.take_queued():
+            placed = self._place(work)
+            if placed:
+                requeued += 1
+            else:
+                shed += 1
+                with self._lock:
+                    self.shed += 1
+                    self.shed_requests += len(work.payloads)
+                if not work.future.done():
+                    # preserve _place's tri-state, same as submit():
+                    # False = backpressure (every READY queue full),
+                    # None = no READY replica at all — a pool outage
+                    # must not be misfiled as queue-full backpressure
+                    work.future.set_exception(LoadShedError(
+                        "queue full" if placed is False
+                        else "no healthy replica"
+                    ))
+        # fresh generation + worker; the old thread retires itself
+        r.restarts += 1
+        r.start_worker()
+        with r._lock:
+            # re-read under the lock, and overwrite ONLY our own
+            # UNHEALTHY mark. Any newer state is someone else's truth:
+            # the swap loop marking SHIFTING (it owns the return to
+            # READY), the swap loop COMPLETING the shift with READY
+            # while this restart ran (restoring prior=SHIFTING over
+            # that would exclude a healthy replica from dispatch
+            # forever), or drain marking STOPPED.
+            if r.state == UNHEALTHY:
+                r.state = SHIFTING if prior == SHIFTING else READY
+        self._emit(
+            "replica", phase="restart", replica=r.rid, device=r.device,
+            version=r.version, reason=reason, requeued=requeued,
+            shed=shed, restarts=r.restarts,
+        )
+
+    # -- blue/green swap -----------------------------------------------
+
+    def swap_status(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._swap_status)
+
+    def swap(
+        self, new_artifact_ref: Any, new_version: str
+    ) -> Dict[str, Any]:
+        """Roll every replica onto ``new_artifact_ref`` under live
+        traffic. Blocking (run it on its own thread — the admin
+        endpoint and the CLI orchestration both do); one swap at a
+        time. Returns the final status dict; raises RuntimeError when a
+        swap is already in progress and propagates a factory failure
+        after marking the status FAILED (serving continues on vN —
+        a bad artifact must never take the pool down)."""
+        if not self._swap_lock.acquire(blocking=False):
+            raise RuntimeError("a swap is already in progress")
+        try:
+            t0 = time.monotonic()
+            status = {
+                "state": SWAP_WARMING,
+                "version_from": self.version,
+                "version_to": str(new_version),
+                "replicas_total": len(self.replicas),
+                "replicas_shifted": 0,
+            }
+            with self._lock:
+                self._swap_status = dict(status)
+            self._emit(
+                "swap", phase="start", version_from=self.version,
+                version_to=str(new_version), replicas=len(self.replicas),
+            )
+            # 1. standby set: build + AOT-warm EVERY new runner before
+            #    any traffic shifts — a failed load aborts with vN
+            #    fully serving
+            try:
+                standby = []
+                for r in self.replicas:
+                    t_w = time.monotonic()
+                    standby.append(
+                        self.runner_factory(
+                            new_artifact_ref, self._device_objs[r.rid]
+                        )
+                    )
+                    self._emit(
+                        "swap", phase="warm", replica=r.rid,
+                        device=r.device, version_to=str(new_version),
+                        seconds=round(time.monotonic() - t_w, 3),
+                    )
+            except Exception as e:
+                status.update(state=SWAP_FAILED, error=str(e))
+                with self._lock:
+                    self._swap_status = dict(status)
+                self._emit(
+                    "swap", phase="failed", version_to=str(new_version),
+                    error=str(e),
+                )
+                raise
+            # 2. shift traffic replica-by-replica: leave the dispatch
+            #    set, let accepted vN work finish, swap the runner,
+            #    rejoin — peers absorb the load meanwhile. State writes
+            #    go under the replica's lock: the health monitor also
+            #    writes state, and an unsynchronized interleave could
+            #    re-admit traffic to the replica this loop is draining.
+            status["state"] = SWAP_SHIFTING
+            with self._lock:
+                self._swap_status = dict(status)
+            for r, runner in zip(self.replicas, standby):
+                if self._draining.is_set():
+                    # the pool is being torn down mid-rollout: stop
+                    # shifting (drain owns the replicas now) and report
+                    # the truth instead of racing restarted states
+                    status.update(
+                        state=SWAP_FAILED,
+                        error="pool drained mid-swap",
+                    )
+                    with self._lock:
+                        self._swap_status = dict(status)
+                    self._emit(
+                        "swap", phase="failed",
+                        version_to=str(new_version),
+                        error="pool drained mid-swap",
+                    )
+                    return dict(status)
+                with r._lock:
+                    r.state = SHIFTING
+                deadline = time.monotonic() + max(
+                    self.wedge_timeout_s, 1.0
+                )
+                while not r.idle() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                # capture the drain outcome BEFORE the runner swaps and
+                # the replica rejoins the dispatch set — after READY,
+                # peers' vN+1 batches land on it and "queue empty now"
+                # no longer says anything about how the vN drain went
+                drained_clean = r.idle()
+                r.swap_runner(runner, str(new_version))
+                with r._lock:
+                    r.state = READY
+                status["replicas_shifted"] += 1
+                with self._lock:
+                    self._swap_status = dict(status)
+                self._emit(
+                    "swap", phase="shift", replica=r.rid,
+                    device=r.device, version_from=status["version_from"],
+                    version_to=str(new_version),
+                    drained_clean=drained_clean,
+                )
+            # 3. vN is drained (no replica runs it anymore); retire it
+            old_version = self.version
+            self.version = str(new_version)
+            self.artifact_ref = new_artifact_ref
+            status.update(
+                state=SWAP_DONE, seconds=round(time.monotonic() - t0, 3)
+            )
+            with self._lock:
+                self._swap_status = dict(status)
+            self._emit(
+                "swap", phase="done", version_from=old_version,
+                version_to=str(new_version),
+                seconds=status["seconds"],
+                replicas_shifted=status["replicas_shifted"],
+            )
+            return dict(status)
+        finally:
+            self._swap_lock.release()
+
+    # -- lifecycle / reporting -----------------------------------------
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Latch the drain flag (submit sheds), execute every queued
+        batch, stop the workers and the health monitor. Every accepted
+        Future resolves before this returns True."""
+        self._draining.set()
+        self._monitor_stop.set()
+        deadline = time.monotonic() + timeout
+        clean = True
+        # monitor FIRST: a restart racing the replica stops below would
+        # resurrect a worker thread drain never joins (start_worker
+        # resets _stopping); _restart_replica also bails on _draining,
+        # so this join is bounded by one in-flight health pass
+        self._monitor.join(timeout=max(deadline - time.monotonic(), 0.1))
+        for r in self.replicas:
+            clean = r.stop(
+                timeout=max(deadline - time.monotonic(), 0.1)
+            ) and clean
+            r.state = STOPPED
+        # belt and braces: a worker that failed to stop in time may
+        # leave queued work — answer it explicitly, never silently
+        for r in self.replicas:
+            for work in r.take_queued():
+                clean = False
+                if not work.future.done():
+                    work.future.set_exception(LoadShedError("draining"))
+        return clean
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            shed = self.shed
+            shed_requests = self.shed_requests
+            dispatched = self.dispatched
+            by_version = dict(self.completed_by_version)
+            swap_status = dict(self._swap_status)
+        reps = [r.snapshot() for r in self.replicas]
+        batches = sum(r["batches"] for r in reps)
+        return {
+            "replicas": reps,
+            "n_replicas": len(reps),
+            "version": self.version,
+            "dispatched": dispatched,
+            "shed": shed,
+            "shed_requests": shed_requests,
+            "batches": batches,
+            "completed": sum(r["completed"] for r in reps),
+            "restarts": sum(r["restarts"] for r in reps),
+            "completed_by_version": by_version,
+            "swap": swap_status,
+        }
+
+
+class PoolAdmin:
+    """The operator surface the HTTP front end's ``/admin/*`` routes
+    call into: the per-replica table, swap status, and the
+    ``POST /admin/swap`` trigger — which resolves its target through
+    the artifact registry (``{"version": N}``, digest-verified) or a
+    raw artifact dir (``{"artifact": "/path"}``), then runs
+    :meth:`ReplicaPool.swap` on its own thread so the admin request
+    returns 202 immediately while the rollout proceeds under traffic.
+
+    ``shed_counter`` (optional) is polled at swap start/end so the
+    swap report can pin "shed caused during the swap window" — the
+    number the zero-shed-due-to-swap acceptance gate reads.
+    """
+
+    def __init__(
+        self,
+        pool: ReplicaPool,
+        *,
+        registry: Any = None,
+        shed_counter: Optional[Callable[[], int]] = None,
+    ):
+        self.pool = pool
+        self.registry = registry
+        self.shed_counter = shed_counter
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._last_swap: Optional[Dict[str, Any]] = None
+        # the target of an ACCEPTED start_swap, recorded before the
+        # rollout thread runs: a swap still in flight (or wedged) at
+        # verdict time must report an honest not-performed block, not
+        # a null that skips every zero-downtime gate
+        self._requested: Optional[str] = None
+
+    def replicas(self) -> Dict[str, Any]:
+        return self.pool.stats()
+
+    def swap_status(self) -> Dict[str, Any]:
+        with self._lock:
+            last = dict(self._last_swap) if self._last_swap else None
+        return {"current": self.pool.swap_status(), "last": last}
+
+    def resolve_target(self, spec: Dict[str, Any]):
+        """``{"version": N}`` (registry, verified) or ``{"artifact":
+        dir}`` -> (artifact_dir, version_label); raises KeyError /
+        ValueError with operator-pointed messages."""
+        if "version" in spec:
+            if self.registry is None:
+                raise ValueError(
+                    "no --registry configured: swap by version needs one"
+                )
+            from bdbnn_tpu.serve.registry import parse_version
+
+            version = parse_version(spec["version"])
+            return (
+                self.registry.resolve(version),
+                self.registry.label(version),
+            )
+        if "artifact" in spec:
+            path = str(spec["artifact"])
+            import os as _os
+
+            if not _os.path.isdir(path):
+                raise KeyError(f"artifact dir not found: {path!r}")
+            return path, _os.path.basename(path.rstrip("/")) or path
+        raise ValueError(
+            'swap body must carry {"version": N} or {"artifact": dir}'
+        )
+
+    def start_swap(self, spec: Dict[str, Any]):
+        """Returns ``(http_status, payload)``: 202 accepted, 409 when a
+        swap is already running, 400/404 on a bad target."""
+        try:
+            artifact_dir, label = self.resolve_target(spec)
+        except (KeyError, FileNotFoundError) as e:
+            return 404, {"error": str(e)}
+        except Exception as e:
+            # total by design: ANY resolution failure (bad spec, digest
+            # mismatch, a version dir torn after publish, ...) must
+            # come back as an HTTP error — an escaped exception would
+            # kill the scheduled swap-trigger thread before
+            # note_request_failed runs, nulling the verdict's swap
+            # block and silently skipping the zero-downtime gate
+            return 400, {"error": str(e)}
+        if len(self.pool.replicas) < 2:
+            # same hazard ServeHttpConfig.validate rejects for
+            # --swap-at: the blue/green shift takes the shifting
+            # replica out of the dispatch set while peers absorb its
+            # load — with one replica every batch assembled during the
+            # shift sheds, so the "zero-downtime" rollout is a
+            # guaranteed outage window
+            return 409, {
+                "error": (
+                    "blue/green swap needs >= 2 replicas: with one "
+                    "replica the shift has no peer to absorb traffic "
+                    "and every request during the swap window sheds "
+                    "(restart serve-http with --replicas >= 2)"
+                )
+            }
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return 409, {
+                    "error": "a swap is already in progress",
+                    "current": self.pool.swap_status(),
+                }
+            shed_before = (
+                self.shed_counter() if self.shed_counter else 0
+            )
+            self._requested = label
+
+            def _run():
+                try:
+                    status = self.pool.swap(artifact_dir, label)
+                except Exception as e:
+                    # the pool records a FULL failed status
+                    # (version_from, replicas_total, ...) before
+                    # re-raising — prefer it over a minimal rebuild,
+                    # as long as it is THIS swap's record
+                    status = self.pool.swap_status()
+                    if (
+                        status.get("version_to") != label
+                        or status.get("state") != SWAP_FAILED
+                    ):
+                        status = {
+                            "state": SWAP_FAILED, "version_to": label,
+                        }
+                    status.setdefault("error", str(e))
+                shed_after = (
+                    self.shed_counter() if self.shed_counter else 0
+                )
+                with self._lock:
+                    self._last_swap = {
+                        **status,
+                        # every shed that happened while the swap was
+                        # rolling, against any layer — the conservative
+                        # upper bound on "shed caused by the swap"
+                        "shed": max(shed_after - shed_before, 0),
+                    }
+
+            self._thread = threading.Thread(
+                target=_run, name="pool-swap", daemon=True
+            )
+            self._thread.start()
+        return 202, {
+            "accepted": True,
+            "version_to": label,
+            "artifact": artifact_dir,
+        }
+
+    def note_request_failed(self, target: Any, error: Any) -> None:
+        """Record a swap REQUEST that was rejected before any rollout
+        could start (bad version, failed digest, missing dir) — the
+        scheduled swap-under-load path calls this on a non-202 so the
+        verdict reports an honest not-performed swap instead of a null
+        that skips every zero-downtime gate. Never overwrites a real
+        rollout's report."""
+        with self._lock:
+            if self._last_swap is None:
+                self._requested = str(target)
+                self._last_swap = {
+                    "state": "rejected",
+                    "version_from": self.pool.version,
+                    "version_to": str(target),
+                    "error": str(error),
+                    "shed": 0,
+                }
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join an in-flight swap (drain-time tidy-up)."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            return not t.is_alive()
+        return True
+
+    def swap_report(self) -> Optional[Dict[str, Any]]:
+        """The verdict's ``swap`` block: the last completed swap's
+        disposition plus the completed-by-version ledger. None only
+        when no swap was ever REQUESTED — a rollout still in flight
+        (or wedged) at report time yields an explicit not-performed
+        block, so the zero-downtime gates fail loudly instead of
+        skipping a null."""
+        with self._lock:
+            last = dict(self._last_swap) if self._last_swap else None
+            requested = self._requested
+        if last is None:
+            if requested is None:
+                return None
+            stats = self.pool.stats()
+            return {
+                "performed": False,
+                "state": self.pool.swap_status().get("state"),
+                "version_from": None,
+                "version_to": requested,
+                "seconds": None,
+                "replicas_shifted": None,
+                "shed": None,
+                "error": "swap did not complete before the report",
+                "answered_by": stats["completed_by_version"],
+            }
+        stats = self.pool.stats()
+        return {
+            "performed": last.get("state") == SWAP_DONE,
+            "state": last.get("state"),
+            "version_from": last.get("version_from"),
+            "version_to": last.get("version_to"),
+            "seconds": last.get("seconds"),
+            "replicas_shifted": last.get("replicas_shifted"),
+            "shed": last.get("shed", 0),
+            "error": last.get("error"),
+            "answered_by": stats["completed_by_version"],
+        }
+
+
+def replica_stats_fields(ps: Dict[str, Any]) -> Dict[str, Any]:
+    """The ``replica phase=stats`` event payload over a
+    :meth:`ReplicaPool.stats` snapshot — one row per replica plus the
+    swap state machine's position, the live heartbeat ``watch``
+    renders. Shared by both serve CLIs (serve-http's pump and the
+    pooled serve-bench passes) so the consumers see ONE shape."""
+    return {
+        "version": ps["version"],
+        "completed": ps["completed"],
+        "restarts": ps["restarts"],
+        "completed_by_version": ps["completed_by_version"],
+        "swap": ps["swap"],
+        "replicas": [
+            {
+                "replica": r["replica"],
+                "device": r["device"],
+                "version": r["version"],
+                "state": r["state"],
+                "queue_depth": r["queue_depth"],
+                "completed": r["completed"],
+            }
+            for r in ps["replicas"]
+        ],
+    }
+
+
+def first_warm_capture():
+    """``(warm_compile, on_engine)`` pair for
+    :func:`make_engine_runner_factory`: records only the FIRST replica
+    engine's per-bucket compile seconds — the representative warmup
+    figure both orchestrations report — without retaining any engine
+    (whole engines are owned by their replicas; keeping them across a
+    sweep would pin every pass's device weights alive at once)."""
+    warm_compile: Dict[Any, float] = {}
+
+    def on_engine(e, d):
+        if not warm_compile:
+            warm_compile.update(e.compile_seconds)
+
+    return warm_compile, on_engine
+
+
+def make_engine_runner_factory(
+    buckets: Sequence[int],
+    *,
+    pace_ms: float = 0.0,
+    on_engine: Optional[Callable[[Any, Any], None]] = None,
+) -> Callable[[str, Any], Callable[[List[Any]], Any]]:
+    """The real runner factory: ``factory(artifact_dir, device) ->
+    runner`` builds an :class:`~bdbnn_tpu.serve.engine.InferenceEngine`
+    with its weights placed and its buckets AOT-warmed on that device,
+    and returns its batched-predict callable.
+
+    ``pace_ms > 0`` swaps the engine's compute for a fixed sleep per
+    batch (weights never load, nothing compiles): the serving-fabric
+    bench mode. On a CPU-simulated mesh every "device" shares the one
+    host's cores, so compute-bound throughput cannot scale with
+    replica count no matter how good the dispatcher is — pacing
+    measures what the POOL adds (dispatch concurrency, queue isolation,
+    swap machinery) with a service time that parallelizes the way a
+    real per-chip engine does. On-chip sweeps (the r06 recipe) run
+    unpaced."""
+    import numpy as np
+
+    pace_s = float(pace_ms) / 1000.0
+
+    def factory(artifact_dir: str, device):
+        if pace_s > 0:
+
+            def paced(payloads: List[Any]):
+                time.sleep(pace_s)
+                return [np.zeros((1,), np.float32)] * len(payloads)
+
+            return paced
+        from bdbnn_tpu.serve.engine import InferenceEngine
+
+        engine = InferenceEngine(
+            artifact_dir, buckets=buckets, device=device
+        )
+        if on_engine is not None:
+            on_engine(engine, device)  # warmup-seconds reporting hook
+
+        def runner(payloads: List[Any]):
+            return engine.predict_logits(np.stack(payloads))
+
+        return runner
+
+    return factory
+
+
+__all__ = [
+    "READY",
+    "SHIFTING",
+    "STOPPED",
+    "SWAP_DONE",
+    "SWAP_FAILED",
+    "SWAP_IDLE",
+    "SWAP_SHIFTING",
+    "SWAP_WARMING",
+    "UNHEALTHY",
+    "WARMING",
+    "PoolAdmin",
+    "Replica",
+    "ReplicaPool",
+    "first_warm_capture",
+    "make_engine_runner_factory",
+    "replica_stats_fields",
+]

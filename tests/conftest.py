@@ -93,6 +93,56 @@ def rng():
 
 
 # ---------------------------------------------------------------------------
+# Simulated-device subprocess harness: one place that knows how to pin a
+# FRESH python process to its own --xla_force_host_platform_device_count
+# (the tests/pod_worker.py env recipe), shared by the reshard tests
+# (test_faults.py — restore onto 4/2 devices) and the replica-pool CLI
+# e2e (test_pool.py — serve-bench --replicas on a clean 8-device mesh).
+# Subprocess isolation matters: the parent session's jax backend is
+# already initialized at 8 devices and cannot be re-pinned in-process.
+# ---------------------------------------------------------------------------
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+
+@pytest.fixture(scope="session")
+def sim_device_subprocess():
+    """Session-scoped runner for device-count-pinned subprocesses:
+    ``run(argv, devices=8, timeout=540) -> CompletedProcess``. The env
+    strips the parent's XLA_FLAGS (workers that pin their own count do
+    so themselves — pod_worker.py / reshard_worker.py), forces the
+    requested count otherwise, pins JAX_PLATFORMS=cpu, and puts the
+    repo root on PYTHONPATH with cwd at the repo root."""
+    import re as _re
+    import subprocess
+    import sys as _sys
+
+    def run(argv, *, devices=8, timeout=540, pin_env=True):
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        if pin_env:
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""),
+            )
+            env["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={devices}"
+            ).strip()
+        env["PYTHONPATH"] = (
+            REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        return subprocess.run(
+            [_sys.executable, *argv],
+            capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+            timeout=timeout,
+        )
+
+    return run
+
+
+# ---------------------------------------------------------------------------
 # Network front-end harness (tests/test_http.py): a session-scoped
 # free-port allocator (two fixtures in one session never race for the
 # same port) and a server-lifecycle factory that guarantees every

@@ -88,14 +88,15 @@ class TestEmitCallSites:
         # the scan actually saw the package's core kinds (guards
         # against the AST walk silently matching nothing) — including
         # the four resilience kinds, the two health-monitor kinds, the
-        # two serving kinds (serve/export.py, serve/loadgen.py) and the
-        # two network-front-end kinds (serve/http.py), which must keep
-        # real call sites
+        # two serving kinds (serve/export.py, serve/loadgen.py), the
+        # two network-front-end kinds (serve/http.py) and the two
+        # replica-pool kinds (serve/http.py's replica heartbeat + the
+        # swap trigger), which must keep real call sites
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
                 "alert", "health", "export", "serve",
-                "http", "admission"} <= found
+                "http", "admission", "replica", "swap"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync."""
@@ -286,6 +287,77 @@ class TestStrictRfc8259:
         assert h["inflight"] == 3 and d["signum"] == 15
         assert a["tenants"]["tenant-a"]["shed_rate"] is None
         assert s["per_priority"]["2"]["p99_ms"] is None
+
+    def test_replica_swap_kind_payloads_roundtrip(self, tmp_path):
+        """The replica-pool payload shapes (serve/pool.py emitted via
+        serve/http.py + serve/loadgen.py) with adversarial values in
+        the numeric slots: a NaN busy-seconds lands as null, numpy
+        counters unwrap, and the nested per-replica table / swap
+        status / completed-by-version ledger survive strict parsing."""
+        ev = EventWriter(str(tmp_path))
+        u = ev.emit(
+            "replica",
+            phase="unhealthy",
+            replica=np.int64(2),
+            device="TFRT_CPU_2",
+            version="v0001",
+            reason="wedged",
+            busy_s=float("nan"),
+        )
+        r = ev.emit(
+            "replica",
+            phase="stats",
+            version="v0002",
+            completed=np.int64(1200),
+            restarts=np.int64(1),
+            completed_by_version={
+                "v0001": np.int64(800), "v0002": 400,
+            },
+            swap={"state": "shifting",
+                  "replicas_shifted": np.int64(3),
+                  "replicas_total": 8},
+            replicas=[
+                {"replica": np.int64(0), "device": "TFRT_CPU_0",
+                 "version": "v0002", "state": "ready",
+                 "queue_depth": np.int64(2), "completed": 600},
+                {"replica": 1, "device": "TFRT_CPU_1",
+                 "version": "v0001", "state": "shifting",
+                 "queue_depth": 0, "completed": np.int64(600)},
+            ],
+        )
+        s = ev.emit(
+            "swap",
+            phase="done",
+            version_from="v0001",
+            version_to="v0002",
+            seconds=np.float32("inf"),
+            replicas_shifted=np.int64(8),
+        )
+        t = ev.emit(
+            "swap",
+            phase="failed",
+            version_to="v0002",
+            error="corrupt artifact",
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "replica"
+        assert lines[0]["busy_s"] is None  # NaN -> null, never a token
+        assert isinstance(lines[0]["replica"], int)
+        assert lines[1]["completed_by_version"] == {
+            "v0001": 800, "v0002": 400,
+        }
+        assert lines[1]["swap"]["replicas_shifted"] == 3
+        assert lines[1]["replicas"][0]["queue_depth"] == 2
+        assert lines[1]["replicas"][1]["state"] == "shifting"
+        assert lines[2]["kind"] == "swap"
+        assert lines[2]["seconds"] is None  # Inf -> null
+        assert lines[2]["replicas_shifted"] == 8
+        assert lines[3]["error"] == "corrupt artifact"
+        # the emit() return values match what was written
+        assert u["busy_s"] is None and r["restarts"] == 1
+        assert s["seconds"] is None and t["phase"] == "failed"
 
     def test_resilience_kind_payloads_roundtrip(self, tmp_path):
         """The extended pod-resilience payload shapes (train/loop.py):

@@ -285,25 +285,25 @@ class TestReshardedResume:
     state, reshard lineage in the ``restore`` event, globally-complete
     sharded eval, and baseline-equal final metrics."""
 
-    def _reshard(self, devices, preempted, baseline, tmp_path):
+    def _reshard(
+        self, devices, preempted, baseline, tmp_path, sim_device_subprocess
+    ):
         victim_dir = preempted["run_dir"]
         saved = _events(victim_dir, "checkpoint")[-1]
         worker = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "reshard_worker.py"
         )
-        repo_root = os.path.dirname(os.path.dirname(worker))
         root = tmp_path / "resumed"
-        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-        env["PYTHONPATH"] = (
-            repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        )
-        proc = subprocess.run(
+        # the shared simulated-device harness (conftest): the worker
+        # pins its own device count from argv, so pin_env=False — the
+        # harness still strips the parent's XLA_FLAGS and sets
+        # PYTHONPATH/cwd
+        proc = sim_device_subprocess(
             [
-                sys.executable, worker, str(devices), victim_dir,
+                worker, str(devices), victim_dir,
                 *_cli_args(root), "--resume", victim_dir,
             ],
-            capture_output=True, text=True, env=env, cwd=repo_root,
-            timeout=540,
+            devices=devices, timeout=540, pin_env=False,
         )
         assert proc.returncode == 0, (
             f"rc={proc.returncode}\nstdout:{proc.stdout[-1500:]}\n"
@@ -329,12 +329,20 @@ class TestReshardedResume:
             baseline["res"]["best_acc1"], abs=1e-3
         )
 
-    def test_restore_onto_4_devices(self, preempted, baseline, tmp_path):
-        self._reshard(4, preempted, baseline, tmp_path)
+    def test_restore_onto_4_devices(
+        self, preempted, baseline, tmp_path, sim_device_subprocess
+    ):
+        self._reshard(
+            4, preempted, baseline, tmp_path, sim_device_subprocess
+        )
 
     @pytest.mark.slow
-    def test_restore_onto_2_devices(self, preempted, baseline, tmp_path):
-        self._reshard(2, preempted, baseline, tmp_path)
+    def test_restore_onto_2_devices(
+        self, preempted, baseline, tmp_path, sim_device_subprocess
+    ):
+        self._reshard(
+            2, preempted, baseline, tmp_path, sim_device_subprocess
+        )
 
 
 @pytest.mark.slow

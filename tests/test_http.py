@@ -128,7 +128,7 @@ class TestHealthReady:
         assert (
             c["completed"] + c["failed"] + c["rejected"]
             + c["shed_draining"] + c["shed_over_quota"]
-            + c["shed_queue_full"]
+            + c["shed_queue_full"] + c["shed_unavailable"]
             == c["submitted"]
         )
         tenants = fe.stats()["admission"]["tenants"]
@@ -479,7 +479,7 @@ class TestVerdictV2:
             scenario="flash_crowd", rate=100.0, seed=0,
             slo_p99_ms=10.0,
         )
-        assert v["serve_verdict"] == 2
+        assert v["serve_verdict"] == 3
         assert v["scenario"] == "flash_crowd"
         # aggregate identity
         assert v["requests_submitted"] == 10
@@ -644,6 +644,35 @@ class TestServeHttpConfig:
             ServeHttpConfig(
                 artifact="a", default_quota="10:0"
             ).validate()
+        # replica-pool / swap orchestration knobs
+        with pytest.raises(ValueError, match="replicas"):
+            ServeHttpConfig(artifact="a", replicas=0).validate()
+        with pytest.raises(ValueError, match="swap-at"):
+            ServeHttpConfig(
+                artifact="a", scenario="poisson", swap_to="v0002",
+                swap_at=1.0,
+            ).validate()
+        with pytest.raises(ValueError, match="swap-to"):
+            ServeHttpConfig(
+                artifact="a", scenario="poisson", swap_at=0.5
+            ).validate()
+        with pytest.raises(ValueError, match="scenario"):
+            ServeHttpConfig(
+                artifact="a", swap_to="v0002", swap_at=0.5
+            ).validate()
+        # --swap-to under a scenario with no --swap-at would run the
+        # whole bench without ever firing the requested swap and exit
+        # 0 with a null swap block — refuse at config time
+        with pytest.raises(ValueError, match="swap-at"):
+            ServeHttpConfig(
+                artifact="a", scenario="poisson", swap_to="v0002"
+            ).validate()
+        # serve mode (no scenario): --swap-to alone stays legal — the
+        # swap is driven externally via POST /admin/swap
+        ServeHttpConfig(artifact="a", swap_to="v0002").validate()
+        assert ServeHttpConfig(artifact="a").pooled is False
+        assert ServeHttpConfig(artifact="a", replicas=2).pooled is True
+        assert ServeHttpConfig(artifact="a", registry="r").pooled is True
 
 
 # ---------------------------------------------------------------------------

@@ -513,6 +513,7 @@ class TestMemoryEvents:
 
 
 class TestProfileAtEndToEnd:
+    @pytest.mark.slow
     def test_profile_at_capture_and_summarize(self, tmp_path):
         """--profile-at on a real (CPU) synthetic fit: the window
         opens/closes exception-free mid-epoch, the trace lands under

@@ -390,7 +390,7 @@ class TestLoadGen:
         )
         assert parsed["shed_rate"] == 0.2
         assert parsed["requests_completed"] == 8
-        assert parsed["serve_verdict"] == 2
+        assert parsed["serve_verdict"] == 3
         # v1 consumers: the v2 blocks exist but are null on a plain
         # serve-bench verdict
         assert parsed["per_priority"] is None
@@ -710,7 +710,7 @@ def _verdict_file(
     per_priority=None, per_tenant=None, fairness=None,
 ):
     v = {
-        "serve_verdict": 2,
+        "serve_verdict": 3,
         "mode": "open",
         "p50_ms": p99 / 3, "p95_ms": p99 / 1.5, "p99_ms": p99,
         "throughput_rps": thr,
@@ -941,3 +941,16 @@ class TestServeBenchConfig:
             ServeBenchConfig(artifact="a", rate=0.0).validate()
         with pytest.raises(ValueError, match="artifact"):
             ServeBenchConfig(artifact="").validate()
+        # replica-pool knobs fail at config time too
+        with pytest.raises(ValueError, match="replicas"):
+            ServeBenchConfig(artifact="a", replicas=(0,)).validate()
+        with pytest.raises(ValueError, match="replicas"):
+            ServeBenchConfig(artifact="a", replicas=()).validate()
+        with pytest.raises(ValueError, match="pace-ms"):
+            ServeBenchConfig(artifact="a", pace_ms=-1.0).validate()
+        with pytest.raises(ValueError, match="replica-queue-batches"):
+            ServeBenchConfig(
+                artifact="a", replica_queue_batches=0
+            ).validate()
+        with pytest.raises(ValueError, match="wedge-timeout"):
+            ServeBenchConfig(artifact="a", wedge_timeout_s=0).validate()
