@@ -699,6 +699,20 @@ def serve_bench_main(argv) -> int:
         help="a replica busy on one batch longer than this is marked "
         "unhealthy, routed around and restarted (default 30)",
     )
+    ap.add_argument(
+        "--packed-weights", default="off", choices=["off", "on", "ab"],
+        help="weight residency: 'on' keeps binary convs 1-bit resident "
+        "in device memory (the jitted forward unpacks transiently; "
+        "logits bitwise-equal to dense); 'ab' runs the SAME load "
+        "dense-then-packed and records the memory squeeze + step-time "
+        "delta in the verdict's packed block (single engine only)",
+    )
+    ap.add_argument(
+        "--packed-impl", default="unpack",
+        choices=["unpack", "popcount"],
+        help="packed reconstruction: unpackbits->conv (default) or the "
+        "XNOR-popcount dot for wide layers (f32 artifacts only)",
+    )
     args = ap.parse_args(argv)
 
     _force_jax_platforms()
@@ -722,6 +736,8 @@ def serve_bench_main(argv) -> int:
         pace_ms=args.pace_ms,
         replica_queue_batches=args.replica_queue_batches,
         wedge_timeout_s=args.wedge_timeout_s,
+        packed_weights=args.packed_weights,
+        packed_impl=args.packed_impl,
     )
     result = run_serve_bench(cfg)
     print(json.dumps(result["verdict"], indent=2, sort_keys=True))
@@ -858,6 +874,36 @@ def serve_http_main(argv) -> int:
         help="a replica busy on one batch longer than this is marked "
         "unhealthy, routed around and restarted (default 30)",
     )
+    ap.add_argument(
+        "--packed-weights", action="store_true",
+        help="keep binary convs 1-bit resident in device memory; the "
+        "jitted forward unpacks transiently per step (logits "
+        "bitwise-equal to dense) — the ~16-32x conv-weight squeeze "
+        "that makes --resident-models affordable",
+    )
+    ap.add_argument(
+        "--packed-impl", default="unpack",
+        choices=["unpack", "popcount"],
+        help="packed reconstruction: unpackbits->conv (default) or the "
+        "XNOR-popcount dot for wide layers (f32 artifacts only)",
+    )
+    ap.add_argument(
+        "--resident-models", type=int, default=1,
+        help="co-resident models per replica (LRU cache): requests "
+        "route by the x-model header to digest-verified registry "
+        "versions WITHOUT a reload in the request path (needs "
+        "--registry; default 1 = x-model rejected)",
+    )
+    ap.add_argument(
+        "--models", nargs="+", default=[],
+        help="with --scenario: registry versions (vNNNN) the load "
+        "generator draws x-model from per request — the co-resident "
+        "multi-model bench mix",
+    )
+    ap.add_argument(
+        "--model-weights", type=float, nargs="+", default=[],
+        help="request mix per --models entry (default uniform)",
+    )
     args = ap.parse_args(argv)
 
     _force_jax_platforms()
@@ -896,6 +942,11 @@ def serve_http_main(argv) -> int:
         swap_at=args.swap_at,
         replica_queue_batches=args.replica_queue_batches,
         wedge_timeout_s=args.wedge_timeout_s,
+        packed_weights=args.packed_weights,
+        packed_impl=args.packed_impl,
+        resident_models=args.resident_models,
+        models=tuple(args.models),
+        model_weights=tuple(args.model_weights),
     )
     result = run_serve_http(cfg)
     print(json.dumps(result["verdict"], indent=2, sort_keys=True))

@@ -282,6 +282,18 @@ class ServeBenchConfig:
     # unhealthy -> routed around -> queued work re-dispatched -> worker
     # restarted (serve/pool.py health monitor)
     wedge_timeout_s: float = 30.0
+    # weight residency (nn/packed.py): "off" = dense reconstructed
+    # weights on device (the classic path); "on" = binary convs stay
+    # 1-bit resident and the jitted forward unpacks transiently;
+    # "ab" = run the SAME load dense-then-packed and record the memory
+    # squeeze + honest step-time delta in the verdict's `packed` block
+    # (single-engine path only — a pooled A/B would conflate dispatch
+    # effects with residency effects)
+    packed_weights: str = "off"
+    # how the packed forward reconstructs: "unpack" (unpackbits -> ±1
+    # -> stock XLA conv, the default) or "popcount" (XNOR-popcount dot
+    # on uint32 lanes — the wide-layer option; f32 artifacts only)
+    packed_impl: str = "unpack"
 
     def validate(self) -> "ServeBenchConfig":
         if not self.artifact:
@@ -313,6 +325,25 @@ class ServeBenchConfig:
             raise ValueError("--replica-queue-batches must be >= 1")
         if self.wedge_timeout_s <= 0:
             raise ValueError("--wedge-timeout-s must be > 0")
+        if self.packed_weights not in ("off", "on", "ab"):
+            raise ValueError(
+                f"--packed-weights must be off|on|ab, got "
+                f"{self.packed_weights!r}"
+            )
+        if self.packed_impl not in ("unpack", "popcount"):
+            raise ValueError(
+                f"--packed-impl must be unpack|popcount, got "
+                f"{self.packed_impl!r}"
+            )
+        if self.packed_weights == "ab" and (
+            tuple(self.replicas) != (1,) or self.pace_ms > 0
+        ):
+            raise ValueError(
+                "--packed-weights ab runs the single-engine path twice "
+                "(dense then packed); it cannot combine with --replicas "
+                "> 1 or --pace-ms — a pooled/paced A/B would conflate "
+                "dispatch effects with residency effects"
+            )
         return self
 
 
@@ -385,12 +416,34 @@ class ServeHttpConfig:
     swap_at: float = 0.0
     replica_queue_batches: int = 8
     wedge_timeout_s: float = 30.0
+    # weight residency (nn/packed.py): keep binary convs 1-bit in
+    # device memory; the jitted forward unpacks transiently per step.
+    # Logits are bitwise-equal to the dense path — the squeeze is what
+    # makes --resident-models > 1 affordable.
+    packed_weights: bool = False
+    packed_impl: str = "unpack"  # unpack | popcount
+    # multi-model residency (serve/pool.py ResidentModelCache): each
+    # replica keeps up to N models resident (LRU) and requests route
+    # by the x-model header to co-resident versions WITHOUT a reload
+    # in the request path. Model keys are registry versions (vNNNN) —
+    # needs --registry. 1 = single-model serving (x-model rejected).
+    resident_models: int = 1
+    # scenario request mix over co-resident models: registry versions
+    # drawn per request (x-model header); empty = every request hits
+    # the default model
+    models: Tuple[str, ...] = ()
+    model_weights: Tuple[float, ...] = ()
 
     @property
     def pooled(self) -> bool:
         """True when the serving path runs through a ReplicaPool: more
-        than one replica, a registry to swap from, or a swap target."""
-        return bool(self.replicas > 1 or self.registry or self.swap_to)
+        than one replica, a registry to swap from, a swap target, or
+        multi-model residency (the per-replica model cache lives in
+        the pool's runner factory)."""
+        return bool(
+            self.replicas > 1 or self.registry or self.swap_to
+            or self.resident_models > 1
+        )
 
     def validate(self) -> "ServeHttpConfig":
         from bdbnn_tpu.serve.loadgen import SCENARIOS
@@ -507,4 +560,72 @@ class ServeHttpConfig:
             raise ValueError("--replica-queue-batches must be >= 1")
         if self.wedge_timeout_s <= 0:
             raise ValueError("--wedge-timeout-s must be > 0")
+        if self.packed_impl not in ("unpack", "popcount"):
+            raise ValueError(
+                f"--packed-impl must be unpack|popcount, got "
+                f"{self.packed_impl!r}"
+            )
+        if self.resident_models < 1:
+            raise ValueError("--resident-models must be >= 1")
+        if self.resident_models > 1 and not self.registry:
+            raise ValueError(
+                "--resident-models > 1 needs --registry: co-resident "
+                "models are routed by x-model naming digest-verified "
+                "registry versions, never arbitrary paths a client "
+                "could choose"
+            )
+        if self.models:
+            if not self.scenario:
+                raise ValueError(
+                    "--models draws x-model per scheduled request; it "
+                    "needs a --scenario (in serve mode clients set "
+                    "x-model themselves)"
+                )
+            if self.resident_models < 2:
+                raise ValueError(
+                    "--models needs --resident-models >= 2: a model "
+                    "mix over a single-model cache would thrash "
+                    "reloads on every batch"
+                )
+            # the steady-state cache-resident set is the DISTINCT
+            # non-default mix entries PLUS the default engine's own
+            # slot (it warms eagerly under the cache's default key); a
+            # mix that cannot co-reside evicts/rebuilds an engine
+            # (seconds of AOT compile) on every batch group — the same
+            # thrash the check above rejects, one notch up
+            from bdbnn_tpu.serve.registry import looks_like_version
+            from bdbnn_tpu.serve.registry import parse_version as _pv
+
+            bad = [m for m in self.models if not looks_like_version(m)]
+            if bad:
+                raise ValueError(
+                    f"--models entries must be registry versions "
+                    f"(vNNNN or an integer), got {bad!r} — the mix is "
+                    "routed by x-model through digest-verified "
+                    "registry versions, never paths (and a non-version "
+                    "entry would otherwise crash the warm loop after "
+                    "the server has already bound)"
+                )
+            cached = {_pv(m) for m in self.models}
+            if looks_like_version(self.artifact):
+                cached.discard(_pv(self.artifact))
+            if len(cached) + 1 > self.resident_models:
+                raise ValueError(
+                    f"--models draws {len(cached)} distinct "
+                    "non-default versions, which plus the default "
+                    f"engine's slot exceeds --resident-models "
+                    f"{self.resident_models}: the overflow would "
+                    "evict and rebuild an engine (seconds of AOT "
+                    "compile) in the request path on every batch — "
+                    "raise --resident-models or trim the mix"
+                )
+        if self.model_weights and (
+            len(self.model_weights) != len(self.models)
+            or any(w < 0 for w in self.model_weights)
+            or sum(self.model_weights) <= 0
+        ):
+            raise ValueError(
+                "--model-weights needs one nonnegative weight per "
+                f"model ({len(self.models)}), summing > 0"
+            )
         return self

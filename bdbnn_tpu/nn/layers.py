@@ -112,18 +112,56 @@ class _BinaryConvBase(nn.Module):
         candidates were deleted with data, see the decision record in
         nn/kernels/binary_conv.py).
 
+        **Packed-apply path (serving).** When the ``packed`` variables
+        collection carries this conv's ``{sign, alpha}`` (1-bit
+        ``np.packbits`` sign + per-output-channel f32 alpha — the
+        export artifact's resident representation, nn/packed.py), the
+        latent ``float_weight`` param is never declared: the dense
+        kernel is reconstructed *transiently inside the jitted forward*
+        (``unpackbits -> ±1 -> * alpha``, every op exact) and fed into
+        the IDENTICAL binarize + conv subgraph — so packed-mode logits
+        are bitwise-equal to dense-mode logits while only the 1-bit
+        payload stays resident in HBM. ``nn.packed.set_packed_impl``
+        optionally reroutes the conv itself through the XNOR-popcount
+        dot (wide layers; also exact in f32).
+
         The ``binarize`` / ``binary_conv`` named scopes land in XLA op
         metadata so device trace events attribute to stable semantic
         categories (obs/trace.py DEVICE_SPANS) instead of fusion names.
         """
+        from bdbnn_tpu.nn.packed import (
+            PACKED_COLLECTION,
+            get_packed_impl,
+            packed_dense_weight,
+            popcount_binary_conv,
+        )
+
+        packed = None
+        if self.has_variable(PACKED_COLLECTION, "sign"):
+            packed = (
+                self.get_variable(PACKED_COLLECTION, "sign"),
+                self.get_variable(PACKED_COLLECTION, "alpha"),
+            )
         with jax.named_scope("binarize"):
-            w = self.latent_weight(in_features).astype(xb.dtype)
+            if packed is not None:
+                shape = (*self.kernel_size, in_features, self.features)
+                with jax.named_scope("unpack"):
+                    w = packed_dense_weight(
+                        packed[0], packed[1], shape
+                    ).astype(xb.dtype)
+            else:
+                w = self.latent_weight(in_features).astype(xb.dtype)
             signed = ste_sign(w)
             reduce_axes = tuple(range(w.ndim - 1))
             alpha = jax.lax.stop_gradient(
                 jnp.mean(jnp.abs(w), axis=reduce_axes)
             )
         with jax.named_scope("binary_conv"):
+            if packed is not None and get_packed_impl() == "popcount":
+                return popcount_binary_conv(
+                    xb, signed, alpha,
+                    strides=self.strides, padding=self.padding,
+                )
             return binary_conv2d_mxu(
                 xb, signed, alpha, strides=self.strides, padding=self.padding
             )

@@ -1,0 +1,225 @@
+"""Packed-weight serving primitives: on-the-fly unpack / popcount dot.
+
+The export artifact already stores every binary conv as XNOR-Net's
+factorization — ``np.packbits`` 1-bit sign + per-output-channel f32
+alpha (arXiv:1603.05279) — but until now the engine reconstructed dense
+``sign * alpha`` tensors on the HOST at load, so a served model occupied
+~16-32x more device memory than its artifact. This module keeps the
+packed representation **resident in device memory** and reconstructs
+dense weights only *transiently inside the jitted eval forward*:
+
+- :func:`unpack_sign_device` — the jnp twin of
+  ``serve.export.unpack_sign``: ``unpackbits -> [:n] -> reshape ->
+  bits*2-1`` — every op exact in f32, so the device reconstruction is
+  bitwise-identical to the host one;
+- :func:`packed_dense_weight` — ``unpack * alpha``, the transient
+  ``float_weight`` the packed-apply path feeds into the SAME binarize +
+  conv subgraph the dense path runs (bitwise-equal logits by
+  construction; pinned per arch in tests/test_packed.py);
+- :func:`popcount_binary_conv` — the optional XNOR-popcount dot for
+  wide layers (arXiv:1911.04477's kernel trick): explicit im2col,
+  ±1/0 operands packed into uint32 lanes, ``lax.population_count``
+  computes the dot as ``valid - 2*popcount((x ^ w) & mask)``. The dot
+  of ±1 vectors is an exact small integer either way, so the popcount
+  result is bitwise-equal to the f32 conv result (f32 compute only —
+  the guard below rejects bf16, whose conv accumulation is inexact
+  past 256 terms).
+
+Why this lives in nn/ and not serve/: the packed-apply path is a MODEL
+property — ``_BinaryConvBase.binary_conv`` (nn/layers.py) consumes the
+``packed`` variables collection when present — and the impl switch
+below is the same trace-time process-global pattern as
+``nn.kernels.binary_conv.default_impl``. The training-side kernel
+decision record (nn/kernels/binary_conv.py) rejected XNOR-popcount for
+the *training* regime; serving is a different regime (weights frozen,
+memory-bound small-batch buckets), which is exactly why it gets its own
+measured decision here instead of inheriting that one.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# the extra variables collection the packed-apply path reads: for each
+# binary conv module, {"sign": uint8 packbits, "alpha": f32 (O,)}
+PACKED_COLLECTION = "packed"
+
+PACKED_IMPLS = ("unpack", "popcount")
+_packed_impl = "unpack"
+
+
+def set_packed_impl(impl: str) -> None:
+    """Set the process-wide packed binary-conv implementation
+    (trace-time, like ``nn.kernels.binary_conv.set_default_impl``):
+    ``unpack`` reconstructs the ±1 kernel and feeds the stock XLA conv;
+    ``popcount`` runs the XNOR-popcount dot on packed uint32 lanes."""
+    global _packed_impl
+    if impl not in PACKED_IMPLS:
+        raise ValueError(
+            f"packed impl must be one of {PACKED_IMPLS}, got {impl!r}"
+        )
+    _packed_impl = impl
+
+
+def get_packed_impl() -> str:
+    return _packed_impl
+
+
+@contextmanager
+def packed_impl(impl: str):
+    prev = get_packed_impl()
+    set_packed_impl(impl)
+    try:
+        yield
+    finally:
+        set_packed_impl(prev)
+
+
+def unpack_sign_device(packed: Array, shape) -> Array:
+    """Device twin of :func:`bdbnn_tpu.serve.export.unpack_sign`: ±1
+    float32 of ``shape`` from a uint8 packbits payload. ``unpackbits``
+    is bit-exact and ``bits*2-1`` maps {0,1} onto {-1,+1} without
+    rounding, so this matches the host reconstruction bitwise."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    bits = jnp.unpackbits(packed)[:n].reshape(shape)
+    return bits.astype(jnp.float32) * 2.0 - 1.0
+
+
+def packed_dense_weight(packed: Array, alpha: Array, shape) -> Array:
+    """The transient dense ``float_weight = sign * alpha`` the
+    packed-apply path materializes inside the jitted forward. Exact
+    twin of what ``load_artifact_variables`` computes on the host
+    (same f32 multiply of the same operands), so the downstream
+    binarize + conv subgraph sees bitwise-identical inputs."""
+    sign = unpack_sign_device(packed, shape)
+    return sign * alpha.astype(jnp.float32)
+
+
+def _pack_words(bits: Array) -> Array:
+    """Pack a bool array's LAST axis (length a multiple of 32) into
+    uint32 words: word w, bit b <- bits[..., 32*w + b]."""
+    shaped = bits.reshape(*bits.shape[:-1], -1, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(shaped << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def popcount_binary_conv(
+    xb: Array,
+    wb_sign: Array,
+    alpha: Array,
+    *,
+    strides: Tuple[int, int] = (1, 1),
+    padding="auto",
+) -> Array:
+    """±alpha binary conv computed as an XNOR-popcount dot.
+
+    ``xb`` ±1 activations (N,H,W,C); ``wb_sign`` ±1 kernel (kh,kw,C,O);
+    ``alpha`` per-output-channel scale. Zero-padding puts a third value
+    (0) into the patches, so the classic ``K - 2*popcount(xor)``
+    identity is masked to the valid lanes:
+
+        dot = popcount(mask) - 2 * popcount((xbits ^ wbits) & mask)
+
+    Both sides of the A/B are exact: the f32 conv on ±1 operands
+    accumulates small integers exactly (|dot| <= kh*kw*C < 2^24) and the
+    popcount path IS integer arithmetic — so the result is bitwise-equal
+    to :func:`bdbnn_tpu.nn.kernels.binary_conv2d_mxu` in f32 (pinned in
+    tests/test_packed.py). bf16 inputs are rejected: bf16 conv
+    accumulation rounds past 256 terms, and a path that silently stops
+    matching the dense forward would poison the fixed-point contract.
+    """
+    if xb.dtype == jnp.bfloat16:
+        raise ValueError(
+            "popcount packed impl needs float32 activations: bf16 conv "
+            "accumulation is inexact past 256 terms, so the popcount "
+            "dot (exact integers) would diverge from the dense forward "
+            "— use packed impl 'unpack' for bf16 artifacts"
+        )
+    kh, kw, c, o = (int(d) for d in wb_sign.shape)
+    sh, sw = (int(s) for s in strides)
+    if padding == "auto":
+        padding = ((kh // 2, kh // 2), (kw // 2, kw // 2))
+    if isinstance(padding, str):
+        raise ValueError(
+            "popcount packed impl wants explicit or 'auto' padding; "
+            f"got {padding!r}"
+        )
+    (pt, pb), (pl, pr) = ((int(a), int(b)) for a, b in padding)
+    n, h, w = int(xb.shape[0]), int(xb.shape[1]), int(xb.shape[2])
+    hout = (h + pt + pb - kh) // sh + 1
+    wout = (w + pl + pr - kw) // sw + 1
+    xpad = jnp.pad(xb, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+
+    # explicit im2col, (kh, kw, C)-ordered to match the natural HWIO
+    # kernel flatten — kh*kw static and small, so the unrolled slices
+    # fuse into one gather-free layout op under XLA
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(
+                xpad[
+                    :,
+                    i : i + sh * (hout - 1) + 1 : sh,
+                    j : j + sw * (wout - 1) + 1 : sw,
+                    :,
+                ]
+            )
+    patches = jnp.concatenate(cols, axis=-1)  # (N, hout, wout, K)
+
+    k = kh * kw * c
+    pad_lanes = (-k) % 32
+    if pad_lanes:
+        patches = jnp.pad(
+            patches, ((0, 0), (0, 0), (0, 0), (0, pad_lanes))
+        )
+    xwords = _pack_words(patches > 0)  # (N, hout, wout, nw)
+    maskwords = _pack_words(patches != 0)
+
+    wflat = wb_sign.reshape(k, o)
+    wbits = wflat > 0
+    if pad_lanes:
+        wbits = jnp.pad(wbits, ((0, pad_lanes), (0, 0)))
+    # (nw, 32, O) -> pack bit axis -> (nw, O)
+    wwords = jnp.sum(
+        wbits.reshape(-1, 32, o).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, :, None],
+        axis=1,
+        dtype=jnp.uint32,
+    )
+
+    valid = jnp.sum(
+        jax.lax.population_count(maskwords), axis=-1, dtype=jnp.int32
+    )  # (N, hout, wout)
+    mismatches = jnp.sum(
+        jax.lax.population_count(
+            (xwords[..., :, None] ^ wwords[None, None, None, :, :])
+            & maskwords[..., :, None]
+        ),
+        axis=-2,
+        dtype=jnp.int32,
+    )  # (N, hout, wout, O)
+    dot = valid[..., None] - 2 * mismatches
+    # identical epilogue to binary_conv2d_mxu: cast, per-channel scale
+    y = dot.astype(xb.dtype)
+    alpha = jnp.reshape(jnp.asarray(alpha, xb.dtype), (1, 1, 1, -1))
+    return (y.astype(alpha.dtype) * alpha).astype(xb.dtype)
+
+
+__all__ = [
+    "PACKED_COLLECTION",
+    "PACKED_IMPLS",
+    "get_packed_impl",
+    "packed_dense_weight",
+    "packed_impl",
+    "popcount_binary_conv",
+    "set_packed_impl",
+    "unpack_sign_device",
+]

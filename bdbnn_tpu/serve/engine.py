@@ -11,10 +11,24 @@ largest bucket first); padding rows are sliced off before the caller
 sees logits.
 
 The model is the SAME flax module the run trained
-(``models.registry.create_model``) applied in eval mode — the artifact
-supplies reconstructed ``float_weight = sign * alpha`` tensors (exact
-fixed point of the training binarizer) and folded-BN identity stats, so
-serve logits match the training run's eval logits to fp32 rounding.
+(``models.registry.create_model``) applied in eval mode. Two residency
+modes for the weights:
+
+- **dense** (default) — the artifact's reconstructed ``float_weight =
+  sign * alpha`` tensors (exact fixed point of the training binarizer)
+  are placed on device, so serve logits match the training run's eval
+  logits to fp32 rounding.
+- **packed** (``packed=True``) — binary convs stay 1-bit in device
+  memory (``np.packbits`` sign + f32 alpha, the artifact's own
+  representation); the jitted forward unpacks them transiently per
+  step (nn/packed.py), so dense weights never become resident. Logits
+  are BITWISE-equal to dense mode (the unpack is exact and feeds the
+  identical subgraph; pinned per arch in tests/test_packed.py), while
+  the resident weight footprint shrinks ~16-32x on the binary convs —
+  the unlock for multi-model residency (serve/pool.py
+  ``ResidentModelCache``). ``packed_impl="popcount"`` reroutes wide
+  binary convs through the XNOR-popcount dot instead of unpack+conv
+  (also exact in f32).
 """
 
 from __future__ import annotations
@@ -41,15 +55,24 @@ class InferenceEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         warm: bool = True,
         device: Optional[Any] = None,
+        packed: bool = False,
+        packed_impl: str = "unpack",
     ):
         from bdbnn_tpu.models.registry import create_model
+        from bdbnn_tpu.nn.packed import PACKED_IMPLS
         from bdbnn_tpu.serve.export import (
+            load_artifact_packed,
             load_artifact_variables,
             read_artifact,
         )
 
         if not buckets or any(int(b) <= 0 for b in buckets):
             raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        if packed_impl not in PACKED_IMPLS:
+            raise ValueError(
+                f"packed_impl must be one of {PACKED_IMPLS}, got "
+                f"{packed_impl!r}"
+            )
         self.artifact_dir = artifact_dir
         self.artifact = read_artifact(artifact_dir)
         self.buckets = tuple(sorted({int(b) for b in buckets}))
@@ -57,13 +80,25 @@ class InferenceEngine:
         self.num_classes = int(self.artifact["num_classes"])
         self.arch = self.artifact["arch"]
         self.dataset = self.artifact["dataset"]
+        self.packed = bool(packed)
+        self.packed_impl = packed_impl
 
         import jax
 
+        model_dtype = self.artifact.get("model", {}).get("dtype", "float32")
+        if self.packed and packed_impl == "popcount" and (
+            model_dtype == "bfloat16"
+        ):
+            # bf16 conv accumulation rounds past 256 terms; the popcount
+            # dot is exact integers — they would silently diverge
+            raise ValueError(
+                "packed_impl='popcount' needs a float32 artifact; this "
+                "one records dtype=bfloat16 — use packed_impl='unpack'"
+            )
         self._model = create_model(
             self.arch,
             self.dataset,
-            dtype=self.artifact.get("model", {}).get("dtype", "float32"),
+            dtype=model_dtype,
             twoblock=bool(
                 self.artifact.get("model", {}).get("twoblock", False)
             ),
@@ -72,11 +107,19 @@ class InferenceEngine:
         # the same placed copies. An explicit device pins this engine
         # to ONE mesh device — the replica-pool path (serve/pool.py)
         # places one engine per device so N replicas execute on N chips
-        # instead of contending for the default one.
+        # instead of contending for the default one. In packed mode the
+        # device_put ships the 1-bit payload, never the dense
+        # reconstruction — THAT is the residency win.
         self.device = device
-        self._variables = jax.device_put(
-            load_artifact_variables(artifact_dir), device
-        )
+        if self.packed:
+            host_vars, self._packed_spec = load_artifact_packed(
+                artifact_dir
+            )
+        else:
+            host_vars, self._packed_spec = (
+                load_artifact_variables(artifact_dir), None
+            )
+        self._variables = jax.device_put(host_vars, device)
         self._compiled: Dict[int, Any] = {}
         self.compile_seconds: Dict[int, float] = {}
         if warm:
@@ -96,8 +139,14 @@ class InferenceEngine:
 
     def warmup(self) -> Dict[int, float]:
         """AOT-compile every bucket; returns per-bucket compile seconds.
-        Idempotent — already-compiled buckets are skipped."""
+        Idempotent — already-compiled buckets are skipped. In packed
+        mode the unpack/popcount impl is bound at trace time (the same
+        process-global pattern as nn.kernels.default_impl), so the
+        compiled executables fuse the reconstruction into the forward
+        and XLA materializes dense weights only transiently per step."""
         import jax
+
+        from bdbnn_tpu.nn.packed import packed_impl as _packed_impl_ctx
 
         for b in self.buckets:
             if b in self._compiled:
@@ -117,11 +166,77 @@ class InferenceEngine:
                 zeros = jax.ShapeDtypeStruct(
                     (b, self.image_size, self.image_size, 3), np.float32
                 )
-            self._compiled[b] = (
-                jax.jit(self._apply).lower(self._variables, zeros).compile()
-            )
+            with _packed_impl_ctx(self.packed_impl):
+                self._compiled[b] = (
+                    jax.jit(self._apply)
+                    .lower(self._variables, zeros)
+                    .compile()
+                )
             self.compile_seconds[b] = round(time.perf_counter() - t0, 3)
         return dict(self.compile_seconds)
+
+    # -- residency accounting ------------------------------------------
+
+    def residency(self) -> Dict[str, Any]:
+        """Resident weight-memory report: the bytes this engine keeps
+        alive in device memory, the bytes the OTHER mode would keep for
+        the same artifact, and their ratio — what the ``memory``
+        serve events and the A/B verdict's ``packed`` block record."""
+        import jax
+
+        resident = int(
+            sum(
+                int(x.nbytes)
+                for x in jax.tree_util.tree_leaves(self._variables)
+            )
+        )
+        if self.packed:
+            dense_equiv = int(self._packed_spec["dense_equiv_bytes"])
+        else:
+            # what load_artifact_packed would keep resident: swap each
+            # binary conv's dense f32 tensor for packbits sign + alpha
+            dense_equiv = resident
+            packed_equiv = resident
+            for t in self.artifact.get("tensors", []):
+                if t["kind"] != "binary":
+                    continue
+                n = int(np.prod(t["shape"]))
+                out_ch = int(t["shape"][-1])
+                packed_equiv += -(n * 4) + ((n + 7) // 8 + out_ch * 4)
+            return {
+                "packed": False,
+                "resident_bytes": resident,
+                "dense_equiv_bytes": dense_equiv,
+                "packed_equiv_bytes": packed_equiv,
+                "ratio": round(resident / max(packed_equiv, 1), 3),
+            }
+        return {
+            "packed": True,
+            "resident_bytes": resident,
+            "dense_equiv_bytes": dense_equiv,
+            "packed_equiv_bytes": resident,
+            "ratio": round(dense_equiv / max(resident, 1), 3),
+        }
+
+    def time_step(
+        self, bucket: Optional[int] = None, iters: int = 10
+    ) -> float:
+        """Mean wall ms per compiled forward on ``bucket`` (default:
+        the largest) — the ``serve_packed_step_ms`` /
+        ``serve_dense_step_ms`` number the A/B verdict records. One
+        unmeasured call first so allocator warmup never taints the
+        mean; every measured call blocks until the result is ready."""
+        b = self.buckets[-1] if bucket is None else int(bucket)
+        if b not in self._compiled:
+            self.warmup()
+        x = np.zeros((b, self.image_size, self.image_size, 3), np.float32)
+        self._compiled[b](self._variables, x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(max(int(iters), 1)):
+            self._compiled[b](self._variables, x).block_until_ready()
+        return round(
+            (time.perf_counter() - t0) * 1000.0 / max(int(iters), 1), 3
+        )
 
     # -- inference -----------------------------------------------------
 
@@ -133,8 +248,14 @@ class InferenceEngine:
 
     def predict_logits(self, images: np.ndarray) -> np.ndarray:
         """Logits for ``images`` (n, H, W, 3) float32, any n >= 1.
-        Pads up to the bucket (chunking through the largest bucket when
-        n exceeds it); callers only ever see the n real rows."""
+
+        One loop over ``max_bucket``-sized chunks: every chunk —
+        including the final short one — pads up to its own bucket and
+        slices the padding back off, so an oversize batch is plain
+        iteration, not a recursive re-entry whose final chunk replays
+        the whole dispatch. Chunk-boundary logit equality (n = big+1,
+        2*big+3) is pinned in tests/test_serve.py; the packed path
+        inherits this seam unchanged."""
         images = np.asarray(images, np.float32)
         if images.ndim == 3:
             images = images[None]
@@ -142,19 +263,17 @@ class InferenceEngine:
         if n == 0:
             return np.zeros((0, self.num_classes), np.float32)
         big = self.buckets[-1]
-        if n > big:
-            return np.concatenate(
-                [
-                    self.predict_logits(images[i : i + big])
-                    for i in range(0, n, big)
-                ]
-            )
-        b = self._bucket_for(n)
-        if n < b:
-            pad = np.zeros((b - n, *images.shape[1:]), np.float32)
-            images = np.concatenate([images, pad])
-        logits = self._compiled[b](self._variables, images)
-        return np.asarray(logits)[:n]
+        out = []
+        for i in range(0, n, big):
+            chunk = images[i : i + big]
+            m = len(chunk)
+            b = self._bucket_for(m)
+            if m < b:
+                pad = np.zeros((b - m, *chunk.shape[1:]), np.float32)
+                chunk = np.concatenate([chunk, pad])
+            logits = self._compiled[b](self._variables, chunk)
+            out.append(np.asarray(logits)[:m])
+        return out[0] if len(out) == 1 else np.concatenate(out)
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         """Top-1 class indices for ``images``."""

@@ -311,20 +311,11 @@ def read_artifact(artifact_dir: str) -> Dict[str, Any]:
         return json.load(f)
 
 
-def load_artifact_variables(artifact_dir: str) -> Dict[str, Any]:
-    """Rebuild the eval-apply ``{params, batch_stats}`` trees from an
-    artifact: binary convs get ``float_weight = sign * alpha`` (the
-    exact fixed point of the training binarizer — re-binarizing it
-    yields the same sign and the same per-channel alpha), folded BNs get
-    identity running stats.
-
-    The weights payload is verified against the manifest's recorded
-    sha256 first: a torn re-export (new weights under a stale manifest,
-    or vice versa) fails loudly here instead of serving the wrong
-    checkpoint."""
-    from bdbnn_tpu.models.resnet import bn_identity_stats
-
-    artifact = read_artifact(artifact_dir)
+def _verified_npz(artifact_dir: str, artifact: Dict[str, Any]):
+    """Open ``weights.npz`` after verifying it against the manifest's
+    recorded sha256: a torn re-export (new weights under a stale
+    manifest, or vice versa) fails loudly here instead of serving the
+    wrong checkpoint. The one verify-then-open both loaders use."""
     wpath = os.path.join(artifact_dir, WEIGHTS_NAME)
     want = artifact.get("weights_sha256")
     if want:
@@ -334,13 +325,28 @@ def load_artifact_variables(artifact_dir: str) -> Dict[str, Any]:
                 f"{ARTIFACT_NAME} — torn or mixed re-export; re-run "
                 "`export` into a fresh directory"
             )
-    z = np.load(wpath)
+    return np.load(wpath)
 
-    def set_path(tree, path, leaf):
-        node = tree
-        for k in path[:-1]:
-            node = node.setdefault(k, {})
-        node[path[-1]] = leaf
+
+def _set_path(tree, path, leaf):
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = leaf
+
+
+def load_artifact_variables(artifact_dir: str) -> Dict[str, Any]:
+    """Rebuild the eval-apply ``{params, batch_stats}`` trees from an
+    artifact: binary convs get ``float_weight = sign * alpha`` (the
+    exact fixed point of the training binarizer — re-binarizing it
+    yields the same sign and the same per-channel alpha), folded BNs get
+    identity running stats. This is the DENSE loader: the reconstructed
+    float tensors stay resident; :func:`load_artifact_packed` is the
+    1-bit-resident alternative."""
+    from bdbnn_tpu.models.resnet import bn_identity_stats
+
+    artifact = read_artifact(artifact_dir)
+    z = _verified_npz(artifact_dir, artifact)
 
     params: Dict[str, Any] = {}
     for t in artifact["tensors"]:
@@ -348,9 +354,9 @@ def load_artifact_variables(artifact_dir: str) -> Dict[str, Any]:
         if t["kind"] == "binary":
             sign = unpack_sign(z[f"sign:{t['path']}"], t["shape"])
             alpha = z[f"alpha:{t['path']}"]
-            set_path(params, path + ("float_weight",), sign * alpha)
+            _set_path(params, path + ("float_weight",), sign * alpha)
         else:
-            set_path(params, path, z[f"dense:{t['path']}"])
+            _set_path(params, path, z[f"dense:{t['path']}"])
 
     batch_stats: Dict[str, Any] = {}
     for bn in artifact["bn_folded"]:
@@ -358,8 +364,80 @@ def load_artifact_variables(artifact_dir: str) -> Dict[str, Any]:
         node = params
         for k in path:
             node = node[k]
-        set_path(batch_stats, path, bn_identity_stats(len(node["scale"])))
+        _set_path(batch_stats, path, bn_identity_stats(len(node["scale"])))
     return {"params": params, "batch_stats": batch_stats}
+
+
+def load_artifact_packed(
+    artifact_dir: str,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Rebuild the eval-apply variables with binary convs kept PACKED:
+    returns ``(variables, spec)`` where ``variables`` carries the usual
+    ``params``/``batch_stats`` trees (dense leaves only — no
+    ``float_weight`` for binary convs) plus a ``packed`` collection of
+    per-conv ``{sign: uint8 packbits, alpha: f32}`` that the model's
+    packed-apply path (nn/layers.py + nn/packed.py) unpacks transiently
+    inside the jitted forward. The whole tree is device-ready: one
+    ``jax.device_put`` keeps the 1-bit payload — not the 16-32x larger
+    dense reconstruction — resident in HBM.
+
+    ``spec`` is the unpack spec the engine's residency accounting and
+    the A/B verdict read: per binary conv the module path, dense shape,
+    packed bytes and dense-equivalent bytes, plus the tree-wide totals.
+    Same digest verification as the dense loader."""
+    from bdbnn_tpu.models.resnet import bn_identity_stats
+
+    artifact = read_artifact(artifact_dir)
+    z = _verified_npz(artifact_dir, artifact)
+
+    params: Dict[str, Any] = {}
+    packed: Dict[str, Any] = {}
+    binary = []
+    packed_bytes = 0
+    dense_equiv = 0
+    for t in artifact["tensors"]:
+        path = tuple(t["path"].split("/"))
+        if t["kind"] == "binary":
+            sign = z[f"sign:{t['path']}"]
+            alpha = np.asarray(z[f"alpha:{t['path']}"], np.float32)
+            _set_path(packed, path + ("sign",), sign)
+            _set_path(packed, path + ("alpha",), alpha)
+            n_dense = int(np.prod(t["shape"])) * 4
+            binary.append({
+                "path": t["path"],
+                "shape": list(t["shape"]),
+                "packed_bytes": int(sign.nbytes + alpha.nbytes),
+                "dense_bytes": n_dense,
+            })
+            packed_bytes += int(sign.nbytes + alpha.nbytes)
+            dense_equiv += n_dense
+        else:
+            arr = z[f"dense:{t['path']}"]
+            _set_path(params, path, arr)
+            packed_bytes += int(arr.nbytes)
+            dense_equiv += int(arr.nbytes)
+
+    batch_stats: Dict[str, Any] = {}
+    for bn in artifact["bn_folded"]:
+        path = tuple(bn.split("/"))
+        node = params
+        for k in path:
+            node = node[k]
+        stats = bn_identity_stats(len(node["scale"]))
+        _set_path(batch_stats, path, stats)
+        nb = sum(int(v.nbytes) for v in stats.values())
+        packed_bytes += nb
+        dense_equiv += nb
+    spec = {
+        "binary": binary,
+        "packed_resident_bytes": packed_bytes,
+        "dense_equiv_bytes": dense_equiv,
+        "ratio": round(dense_equiv / max(packed_bytes, 1), 3),
+    }
+    return (
+        {"params": params, "batch_stats": batch_stats, "packed": packed},
+        spec,
+    )
 
 
 __all__ = [
@@ -368,6 +446,7 @@ __all__ = [
     "FORBIDDEN_STATE",
     "WEIGHTS_NAME",
     "export_artifact",
+    "load_artifact_packed",
     "load_artifact_variables",
     "read_artifact",
     "unpack_sign",

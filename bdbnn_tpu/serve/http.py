@@ -65,6 +65,7 @@ from bdbnn_tpu.serve.admission import (
     AdmissionController,
 )
 from bdbnn_tpu.serve.batching import LoadShedError, MicroBatcher
+from bdbnn_tpu.serve.pool import DEFAULT_MODEL
 
 _REASONS = {
     200: "OK",
@@ -124,6 +125,7 @@ class HttpFrontEnd:
         default_priority: Optional[int] = None,
         retry_after_s: int = 1,
         admin: Optional[Any] = None,
+        model_router: Optional[Callable[[str], str]] = None,
     ):
         self.batcher = batcher
         self.admission = admission
@@ -145,6 +147,17 @@ class HttpFrontEnd:
         # GET /admin/replicas, GET/POST /admin/swap. None = the admin
         # routes 404 (single-engine serving has no pool to administer).
         self.admin = admin
+        # multi-model residency (serve/pool.py ResidentModelCache):
+        # maps an ``x-model`` header value to a model key the batcher
+        # payloads carry — requests route to co-resident packed
+        # versions without a reload. Raises KeyError on an unknown or
+        # unverifiable model -> 404, ledgered as `rejected` (the
+        # client named something unservable; neither completed nor
+        # shed). None = the header is rejected outright: a server not
+        # configured for multi-model must not silently ignore a
+        # routing request and answer from the wrong model.
+        self.model_router = model_router
+        self._completed_by_model: Dict[str, int] = {}
         self._draining = threading.Event()
         # in-flight = /v1/predict handlers between request-parsed and
         # response-written; open connections additionally tracked in
@@ -513,6 +526,39 @@ class HttpFrontEnd:
             )
             return
         assert decision == ADMIT
+        raw_model = headers.get("x-model")
+        model_key = None
+        if raw_model is not None and self.model_router is None:
+            # no router configured: answering from the (only) resident
+            # model while the client asked for a specific one would be
+            # silently wrong — explicit 404, ledgered like a bad body
+            counts["rejected"] += 1
+            self.admission.record_rejected(tenant)
+            self._respond(writer, 404, {
+                "error": "multi-model routing disabled "
+                "(start serve-http with --resident-models >= 2)",
+                "model": raw_model,
+            })
+            return
+        if self.model_router is not None:
+            try:
+                # off-loop: the first request naming an unseen model
+                # pays a full registry digest walk (sha256 over
+                # weights.npz) inside the router — on the event loop
+                # that would stall every other connection for the
+                # duration (the admin swap handler makes the same
+                # move for the same reason); memoized hits return in
+                # microseconds either way
+                model_key = await asyncio.get_running_loop(
+                ).run_in_executor(None, self.model_router, raw_model)
+            except KeyError as e:
+                counts["rejected"] += 1
+                self.admission.record_rejected(tenant)
+                self._respond(writer, 404, {
+                    "error": f"unknown model: {e.args[0] if e.args else raw_model}",
+                    "model": raw_model,
+                })
+                return
         try:
             payload = self.decode(
                 body, headers.get("content-type", "")
@@ -527,6 +573,10 @@ class HttpFrontEnd:
                 writer, 400, {"error": f"undecodable body: {e}"}
             )
             return
+        if self.model_router is not None:
+            # the batcher payload carries the routing decision; the
+            # pool runner groups each coalesced batch by model key
+            payload = (model_key, payload)
         try:
             fut = self.batcher.submit(payload, priority=priority)
         except LoadShedError as e:
@@ -566,10 +616,18 @@ class HttpFrontEnd:
         self._lat_by_priority[priority].append(lat_ms)
         counts["completed"] += 1
         self.admission.record_completed(tenant)
+        if self.model_router is not None:
+            # keyed by pool.DEFAULT_MODEL so resident_block can merge
+            # this ledger into the cache-stats rows it keys the same
+            key = model_key or DEFAULT_MODEL
+            self._completed_by_model[key] = (
+                self._completed_by_model.get(key, 0) + 1
+            )
         self._respond(writer, 200, {
             "result": self.encode(result),
             "priority": priority,
             "tenant": tenant,
+            "model": model_key,
             "latency_ms": round(lat_ms, 3),
         })
         await writer.drain()
@@ -619,6 +677,7 @@ class HttpFrontEnd:
             "counts_by_priority": [
                 dict(c) for c in self._counts_by_priority
             ],
+            "completed_by_model": dict(self._completed_by_model),
             "requests_seen": self._requests_seen,
         }
 
@@ -710,7 +769,8 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         engine: Any = _ArtifactMeta(artifact_dir, cfg.buckets)
     else:
         engine = InferenceEngine(
-            artifact_dir, buckets=cfg.buckets, warm=False
+            artifact_dir, buckets=cfg.buckets, warm=False,
+            packed=cfg.packed_weights, packed_impl=cfg.packed_impl,
         )
 
     stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
@@ -745,6 +805,10 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             else None,
             "swap_to": cfg.swap_to or None,
             "swap_at": cfg.swap_at or None,
+            "packed_weights": cfg.packed_weights,
+            "packed_impl": cfg.packed_impl,
+            "resident_models": cfg.resident_models,
+            "models": list(cfg.models) or None,
         },
     )
     events = EventWriter(run_dir, max_bytes=int(cfg.events_max_mb * 2**20))
@@ -862,6 +926,61 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         (lambda: bool(pool_ref)) if cfg.pooled
         else (lambda: engine.warmed)
     )
+    # multi-model routing: x-model names a digest-verified registry
+    # version; resolution (the sha256 chain walk) is memoized into
+    # model_dirs, which the replica caches' loader also reads — one
+    # verification per model per server life, never per request
+    model_dirs: Dict[str, str] = {}
+    model_router = None
+    if cfg.resident_models > 1:
+        from bdbnn_tpu.serve.registry import parse_version
+
+        def model_router(header):
+            if header is None:
+                return None
+            try:
+                version = parse_version(header)
+            except ValueError as e:
+                raise KeyError(str(e))
+            label = registry.label(version)
+            # x-model naming the server's CURRENT default version
+            # routes to the default resident engine — never a second
+            # copy of the same weights in the cache. The default is
+            # read from the live pool, not captured at startup: after
+            # a blue/green swap the old default label must cache-route
+            # to its own (old-version) engine, and the NEW version's
+            # label must short-circuit — a startup capture would
+            # silently answer old-label requests with new weights
+            current_default = (
+                pool_ref[0].version if pool_ref else version_label
+            )
+            if label == current_default:
+                return None
+            if label not in model_dirs:
+                try:
+                    model_dirs[label] = registry.resolve(version)
+                except Exception as e:
+                    # unknown version, torn dir, digest mismatch — all
+                    # 404 to the client, none may reach an engine
+                    raise KeyError(str(e))
+            return label
+
+        # the scenario's model mix resolves BEFORE the listener binds:
+        # a well-formed but unpublished/torn version must fail here as
+        # a startup error, not crash the eager warm loop after the
+        # socket is bound and the run dir is open (config validation
+        # can only check the NAME shape; only the registry can check
+        # existence and digests). Resolution is memoized into
+        # model_dirs, so the warm loop and request path reuse it.
+        for label in cfg.models:
+            try:
+                model_router(label)
+            except KeyError as e:
+                raise ValueError(
+                    f"--models entry {label!r} cannot be served: "
+                    f"{e.args[0] if e.args else e}"
+                )
+
     front = HttpFrontEnd(
         batcher,
         admission,
@@ -871,6 +990,7 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         host=cfg.host,
         port=cfg.port,
         max_body_bytes=int(cfg.max_body_mb * 2**20),
+        model_router=model_router,
     )
     host, port = front.start()
     events.emit(
@@ -906,7 +1026,13 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
 
         warm_compile, _on_engine = first_warm_capture()
         factory = make_engine_runner_factory(
-            cfg.buckets, on_engine=_on_engine
+            cfg.buckets,
+            on_engine=_on_engine,
+            packed=cfg.packed_weights,
+            packed_impl=cfg.packed_impl,
+            resident_models=cfg.resident_models,
+            model_dirs=model_dirs,
+            on_event=lambda kind, **f: events.emit(kind, **f),
         )
         pool = ReplicaPool(
             factory,
@@ -917,6 +1043,17 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             wedge_timeout_s=cfg.wedge_timeout_s,
             on_event=lambda kind, **f: events.emit(kind, **f),
         )
+        if cfg.models and model_router is not None:
+            # the scenario's model mix is KNOWN up front: warm every
+            # named co-resident model on every replica BEFORE readyz
+            # flips, so no scheduled request pays a cold load+compile
+            # mid-bench (an UNNAMED x-model still cold-loads lazily —
+            # that latency is the client's explicit choice). A model
+            # key of None is the default version — already resident.
+            keys = {model_router(label) for label in cfg.models}
+            for cache in factory.caches:
+                for key in sorted(k for k in keys if k is not None):
+                    cache.get(key)
         pool_ref.append(pool)  # readyz flips 200 from here
         admin = PoolAdmin(
             pool,
@@ -939,6 +1076,41 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         host=host, port=port,
         replicas=cfg.replicas if cfg.pooled else None,
     )
+
+    from bdbnn_tpu.serve.pool import (
+        resident_block,
+        single_engine_resident_block,
+    )
+
+    def _resident_snapshot():
+        """The verdict's ``resident`` block (and the serve_resident
+        memory event's source): per-model resident device bytes from
+        the replica caches — or, on the single-engine path, the one
+        engine's own residency report (shared shape, pool.py)."""
+        if cfg.pooled:
+            return resident_block(
+                getattr(factory, "caches", []),
+                completed_by_model=(
+                    front.accounting()["completed_by_model"] or None
+                ),
+            )
+        return single_engine_resident_block(engine.residency())
+
+    resident_now = _resident_snapshot()
+    if resident_now is not None:
+        events.emit(
+            "memory",
+            phase="serve_resident",
+            available=True,
+            devices=[],
+            peak_bytes=None,
+            limit_bytes=None,
+            weights_mode="packed" if cfg.packed_weights else "dense",
+            packed_impl=cfg.packed_impl if cfg.packed_weights else None,
+            resident_bytes=resident_now["bytes_per_model_max"],
+            models=len(resident_now["models"]),
+            replicas=resident_now["replicas"],
+        )
 
     # periodic live-state events: per-priority depths, per-tenant
     # sheds, readiness — what `watch` renders for a serving run
@@ -1002,6 +1174,11 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
                 diurnal_amp=cfg.diurnal_amp,
                 heavy_sigma=cfg.heavy_sigma,
                 slow_fraction=cfg.slow_fraction,
+                models=list(cfg.models) or None,
+                model_weights=(
+                    list(cfg.model_weights)
+                    if cfg.model_weights else None
+                ),
             )
             # swap-under-load: after --swap-at of the schedule has been
             # OFFERED, fire the same blue/green rollout the admin
@@ -1096,6 +1273,40 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
 
     admission_stats = admission.stats()
     events.emit("admission", phase="summary", **admission_stats)
+    resident_final = _resident_snapshot()
+    packed_block = None
+    if cfg.packed_weights and resident_final is not None:
+        rows = list(resident_final["models"].values())
+        p_bytes = max(
+            (m["resident_bytes"] for m in rows
+             if m.get("resident_bytes") is not None),
+            default=None,
+        )
+        d_bytes = max(
+            (m["dense_equiv_bytes"] for m in rows
+             if m.get("dense_equiv_bytes") is not None),
+            default=None,
+        )
+        packed_block = {
+            "mode": "on",
+            "impl": cfg.packed_impl,
+            # serve-http measures no dense side (that A/B is
+            # serve-bench's job); the dense resident figure is the
+            # computed equivalent, honest about what was NOT measured
+            "dense": {
+                "resident_bytes": d_bytes, "step_ms": None,
+                "throughput_rps": None, "p99_ms": None,
+            },
+            "packed": {
+                "resident_bytes": p_bytes, "step_ms": None,
+                "throughput_rps": None, "p99_ms": None,
+            },
+            "resident_ratio": (
+                round(d_bytes / max(p_bytes, 1), 3)
+                if d_bytes is not None and p_bytes is not None else None
+            ),
+            "step_ms_delta_pct": None,
+        }
     verdict = http_slo_verdict(
         front.accounting(),
         batcher.stats(),
@@ -1118,6 +1329,8 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             else None
         ),
         swap=admin.swap_report() if admin is not None else None,
+        resident=resident_final,
+        packed=packed_block,
     )
     events.emit("serve", phase="verdict", **verdict)
     events.emit("http", phase="stop", host=host, port=port)

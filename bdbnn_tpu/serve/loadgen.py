@@ -272,10 +272,19 @@ class LoadGenerator:
 # ---------------------------------------------------------------------------
 
 # one scheduled request of a scenario: seconds-from-start, priority
-# class, tenant, and whether the CLIENT dribbles the body (slow-client
+# class, tenant, whether the CLIENT dribbles the body (slow-client
 # scenario — the server must tolerate slow writers without stalling
-# everyone else)
-Arrival = namedtuple("Arrival", ("t", "priority", "tenant", "slow"))
+# everyone else), and which co-resident model the request targets
+# (x-model header; None = the server's default model)
+Arrival = namedtuple(
+    "Arrival", ("t", "priority", "tenant", "slow", "model"),
+    defaults=(None,),
+)
+
+# the provenance scalars the verdict needs from an engine — held
+# instead of the engine itself so dropping the engine actually frees
+# its device weights (the A/B bench relies on this)
+_EngineMeta = namedtuple("_EngineMeta", ("arch", "dataset"))
 
 SCENARIOS = (
     "poisson", "diurnal", "flash_crowd", "heavy_tail", "slow_client",
@@ -310,6 +319,8 @@ def build_schedule(
     diurnal_amp: float = 0.8,
     heavy_sigma: float = 1.5,
     slow_fraction: float = 0.2,
+    models: Optional[Sequence[str]] = None,
+    model_weights: Optional[Sequence[float]] = None,
 ) -> List[Arrival]:
     """A deterministic arrival schedule for one scenario — drawn up
     front from ``random.Random(seed)``, so the OFFERED load is
@@ -360,6 +371,13 @@ def build_schedule(
             f"tenant_weights must have {len(tenants)} entries, got "
             f"{len(tenant_weights)}"
         )
+    if models and model_weights is None:
+        model_weights = [1.0] * len(models)
+    if models and len(model_weights) != len(models):
+        raise ValueError(
+            f"model_weights must have {len(models)} entries, got "
+            f"{len(model_weights)}"
+        )
     rng = random.Random(seed)
     duration = requests / rate  # nominal run length at the base rate
     flash_t0, flash_t1 = duration / 3.0, duration / 3.0 + duration / 6.0
@@ -390,6 +408,10 @@ def build_schedule(
             ),
             tenant=_weighted_pick(rng, list(tenants), tenant_weights),
             slow=slow,
+            model=(
+                _weighted_pick(rng, list(models), model_weights)
+                if models else None
+            ),
         ))
     return out
 
@@ -476,12 +498,17 @@ class HttpLoadGenerator:
 
     def _send(self, sock, i: int, arr: Arrival) -> None:
         body = self.body_fn(i)
+        model = (
+            f"x-model: {arr.model}\r\n"
+            if getattr(arr, "model", None) else ""
+        )
         head = (
             f"POST {self.path} HTTP/1.1\r\n"
             f"host: {self.host}:{self.port}\r\n"
             f"x-priority: {arr.priority}\r\n"
             f"x-tenant: {arr.tenant}\r\n"
-            f"content-type: {self.content_type}\r\n"
+            + model
+            + f"content-type: {self.content_type}\r\n"
             f"content-length: {len(body)}\r\n\r\n"
         ).encode("latin-1")
         if not arr.slow:
@@ -648,6 +675,8 @@ def slo_verdict(
     replicas: Optional[Dict[str, Any]] = None,
     scaling: Optional[Dict[str, Any]] = None,
     swap: Optional[Dict[str, Any]] = None,
+    resident: Optional[Dict[str, Any]] = None,
+    packed: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble the deterministic strict-JSON SLO verdict.
 
@@ -661,7 +690,14 @@ def slo_verdict(
     time). The replica pool (serve/pool.py) adds the v3 blocks:
     ``replicas`` (the per-replica table + completed-by-version
     ledger), ``scaling`` (the --replicas sweep summary) and ``swap``
-    (blue/green rollout disposition)."""
+    (blue/green rollout disposition). Packed residency (nn/packed.py)
+    flattens two more nullable blocks into v3: ``resident`` (per-model
+    resident device bytes + the model cache's LRU accounting — the
+    number ``compare`` judges as ``serve_resident_bytes_per_model``)
+    and ``packed`` (the packed-vs-dense A/B: resident squeeze ratio +
+    the honest per-step time on each side, ``serve_packed_step_ms``).
+    Both are null on pre-packed runs, so v1/v2/v3-without-packed
+    verdicts skip the new metrics cleanly."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
@@ -698,6 +734,8 @@ def slo_verdict(
         "replicas": replicas,
         "scaling": scaling,
         "swap": swap,
+        "resident": resident,
+        "packed": packed,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (
@@ -728,6 +766,8 @@ def http_slo_verdict(
     slo_p99_ms: float = 0.0,
     replicas: Optional[Dict[str, Any]] = None,
     swap: Optional[Dict[str, Any]] = None,
+    resident: Optional[Dict[str, Any]] = None,
+    packed: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Build the v2 verdict from the HTTP front end's request ledger
     (:meth:`serve.http.HttpFrontEnd.accounting`), the batcher's
@@ -816,6 +856,8 @@ def http_slo_verdict(
         slo=slo,
         replicas=replicas,
         swap=swap,
+        resident=resident,
+        packed=packed,
     )
 
 
@@ -979,6 +1021,7 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
         first_warm_capture,
         make_engine_runner_factory,
         replica_stats_fields,
+        resident_block,
     )
 
     paced = cfg.pace_ms > 0
@@ -998,6 +1041,8 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
             **_bench_manifest_fields(cfg, engine, prov, recipe),
             "replicas": list(sweep),
             "pace_ms": cfg.pace_ms,
+            "packed_weights": cfg.packed_weights,
+            "packed_impl": cfg.packed_impl,
         },
     )
     events = EventWriter(run_dir, max_bytes=int(cfg.events_max_mb * 2**20))
@@ -1021,6 +1066,9 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
         cfg.buckets,
         pace_ms=cfg.pace_ms,
         on_engine=_on_engine,
+        packed=cfg.packed_weights == "on",
+        packed_impl=cfg.packed_impl,
+        on_event=lambda kind, **f: events.emit(kind, **f),
     )
     rng = np.random.default_rng(cfg.seed)
     img_pool = rng.standard_normal(
@@ -1030,9 +1078,15 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
 
     throughput: Dict[str, float] = {}
     passes: Dict[int, Any] = {}
+    caches_per_pass: Dict[int, Any] = {}
     for n in sweep:
         if handler.preempted:
             break
+        # snapshot by IDENTITY, not index: the factory REPLACES a
+        # re-used device's stale cache in place (removal shifts list
+        # indices), so a tail slice would miss re-created caches for
+        # devices earlier passes already used
+        caches_before = {id(c) for c in factory.caches}
         if paced:
             devices: List[Any] = [f"paced:{i}" for i in range(n)]
         else:
@@ -1123,6 +1177,9 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
         thr = round(raw["completed"] / max(raw["wall_s"], 1e-9), 3)
         throughput[str(n)] = thr
         passes[n] = (raw, batcher.stats(), pool.stats(), drained)
+        caches_per_pass[n] = [
+            c for c in factory.caches if id(c) not in caches_before
+        ]
         events.emit(
             "serve",
             phase="scaling",
@@ -1136,12 +1193,29 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
     if passes:
         n_last = max(passes)
         raw, batcher_stats, pool_stats, drained_clean = passes[n_last]
+        resident = resident_block(caches_per_pass.get(n_last, []))
+        if resident is not None:
+            events.emit(
+                "memory",
+                phase="serve_resident",
+                available=True,
+                devices=[],
+                peak_bytes=None,
+                limit_bytes=None,
+                weights_mode=(
+                    "packed" if cfg.packed_weights == "on" else "dense"
+                ),
+                resident_bytes=resident["bytes_per_model_max"],
+                models=len(resident["models"]),
+                replicas=resident["replicas"],
+            )
     else:
         # preempted before the first pass could offer load: an honest
         # empty verdict, drained by construction
         raw = {"submitted": 0, "completed": 0, "shed": 0, "failed": 0,
                "wall_s": 0.0, "latencies_ms": []}
         batcher_stats, pool_stats, drained_clean = {}, None, True
+        resident = None
 
     scaling = None
     if len(passes) > 1:
@@ -1175,6 +1249,7 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
         drained_clean=drained_clean,
         replicas=_pool_replicas_block(pool_stats),
         scaling=scaling,
+        resident=resident,
     )
     events.emit("serve", phase="verdict", **verdict)
     events.close()
@@ -1183,6 +1258,16 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
 
 
 def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
+    """The single-engine serve-bench, now residency-aware: with
+    ``--packed-weights on`` the engine keeps its binary convs 1-bit
+    resident (nn/packed.py); with ``ab`` the SAME load runs twice —
+    dense first, then packed — and the verdict's ``packed`` block
+    records the memory squeeze (resident bytes per side + ratio) and
+    an honest per-step time delta, even when step time is a wash. The
+    primary verdict aggregates come from the PACKED pass (the
+    configuration being shipped); each pass emits a ``memory`` event
+    (phase ``serve_resident``) recording resident-bytes before/after
+    the squeeze."""
     import datetime
 
     import numpy as np
@@ -1191,110 +1276,240 @@ def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
     from bdbnn_tpu.obs.manifest import write_manifest
     from bdbnn_tpu.serve.engine import InferenceEngine
 
-    engine = InferenceEngine(cfg.artifact, buckets=cfg.buckets)
-    warmup_s = dict(engine.compile_seconds)
+    mode_plan = {
+        "off": (("dense", False),),
+        "on": (("packed", True),),
+        "ab": (("dense", False), ("packed", True)),
+    }[cfg.packed_weights]
 
-    stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
-    run_dir = os.path.join(cfg.log_path, stamp)
-    os.makedirs(run_dir, exist_ok=True)
-    prov = engine.artifact.get("provenance", {})
-    recipe = prov.get("recipe") or {}
-    manifest = write_manifest(
-        run_dir, _bench_manifest_fields(cfg, engine, prov, recipe)
+    run_dir = os.path.join(
+        cfg.log_path,
+        datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S"),
     )
+    os.makedirs(run_dir, exist_ok=True)
     events = EventWriter(
         run_dir, max_bytes=int(cfg.events_max_mb * 2**20)
     )
-    events.emit(
-        "serve",
-        phase="start",
-        artifact=os.path.abspath(cfg.artifact),
-        arch=engine.arch,
-        buckets=list(cfg.buckets),
-        warmup_compile_s=warmup_s,
-        mode=cfg.mode,
-        # closed mode offers no Poisson load — null, like the verdict
-        rate_rps=cfg.rate if cfg.mode == "open" else None,
-        requests=cfg.requests,
-        queue_depth=cfg.queue_depth,
-        max_delay_ms=cfg.max_delay_ms,
-    )
 
-    # rolling p99 over a sliding latency window for the live `serve`
-    # stats events `watch` renders
-    window: List[float] = []
-    win_lock = threading.Lock()
-    batch_counter = [0]
-    emit_every = max(cfg.requests // (20 * max(engine.buckets[-1], 1)), 1)
-
-    def on_batch(stats: Dict[str, Any]) -> None:
-        # per-batch latency proxy: oldest request's queue wait + run
-        with win_lock:
-            window.append(stats["oldest_wait_ms"] + stats["run_ms"])
-            del window[:-256]
-            rolling = sorted(window)
-            batch_counter[0] += 1
-            n = batch_counter[0]
-        if n % emit_every == 0:
-            events.emit(
-                "serve",
-                phase="stats",
-                batch_size=stats["batch_size"],
-                occupancy=stats["occupancy"],
-                queue_depth=stats["queue_depth"],
-                rolling_p99_ms=_pct(rolling, 99.0),
-                completed=stats["completed"],
-                shed=stats["shed"],
+    manifest = None
+    prov: Dict[str, Any] = {}
+    recipe: Dict[str, Any] = {}
+    passes: Dict[str, Dict[str, Any]] = {}
+    engine_meta: Any = None
+    for label, is_packed in mode_plan:
+        if handler.preempted and passes:
+            break
+        engine = InferenceEngine(
+            cfg.artifact,
+            buckets=cfg.buckets,
+            packed=is_packed,
+            packed_impl=cfg.packed_impl,
+        )
+        warmup_s = dict(engine.compile_seconds)
+        if manifest is None:
+            prov = engine.artifact.get("provenance", {})
+            recipe = prov.get("recipe") or {}
+            manifest = write_manifest(
+                run_dir,
+                {
+                    **_bench_manifest_fields(cfg, engine, prov, recipe),
+                    "packed_weights": cfg.packed_weights,
+                    "packed_impl": cfg.packed_impl,
+                },
             )
+        residency = engine.residency()
+        # the residency datapoint: what THIS mode keeps alive in device
+        # memory vs what the other mode would — before/after on one
+        # timeline, consumable by any `memory`-event reader
+        events.emit(
+            "memory",
+            phase="serve_resident",
+            available=True,
+            devices=[],
+            peak_bytes=None,
+            limit_bytes=None,
+            weights_mode=label,
+            packed_impl=cfg.packed_impl if is_packed else None,
+            resident_bytes=residency["resident_bytes"],
+            dense_equiv_bytes=residency["dense_equiv_bytes"],
+            packed_equiv_bytes=residency["packed_equiv_bytes"],
+            ratio=residency["ratio"],
+        )
+        step_ms = engine.time_step(iters=5)
+        events.emit(
+            "serve",
+            phase="start",
+            artifact=os.path.abspath(cfg.artifact),
+            arch=engine.arch,
+            buckets=list(cfg.buckets),
+            warmup_compile_s=warmup_s,
+            mode=cfg.mode,
+            # closed mode offers no Poisson load — null, like verdict
+            rate_rps=cfg.rate if cfg.mode == "open" else None,
+            requests=cfg.requests,
+            queue_depth=cfg.queue_depth,
+            max_delay_ms=cfg.max_delay_ms,
+            weights_mode=label,
+        )
 
-    def runner(samples: List[np.ndarray]):
-        return engine.predict_logits(np.stack(samples))
+        # rolling p99 over a sliding latency window for the live
+        # `serve` stats events `watch` renders
+        window: List[float] = []
+        win_lock = threading.Lock()
+        batch_counter = [0]
+        emit_every = max(
+            cfg.requests // (20 * max(engine.buckets[-1], 1)), 1
+        )
 
-    batcher = MicroBatcher(
-        runner,
-        max_batch=engine.buckets[-1],
-        max_queue=cfg.queue_depth,
-        max_delay_ms=cfg.max_delay_ms,
-        on_batch=on_batch,
+        def on_batch(stats: Dict[str, Any]) -> None:
+            # per-batch latency proxy: oldest request's wait + run
+            with win_lock:
+                window.append(stats["oldest_wait_ms"] + stats["run_ms"])
+                del window[:-256]
+                rolling = sorted(window)
+                batch_counter[0] += 1
+                n = batch_counter[0]
+            if n % emit_every == 0:
+                events.emit(
+                    "serve",
+                    phase="stats",
+                    batch_size=stats["batch_size"],
+                    occupancy=stats["occupancy"],
+                    queue_depth=stats["queue_depth"],
+                    rolling_p99_ms=_pct(rolling, 99.0),
+                    completed=stats["completed"],
+                    shed=stats["shed"],
+                )
+
+        def runner(samples: List[np.ndarray], engine=engine):
+            return engine.predict_logits(np.stack(samples))
+
+        batcher = MicroBatcher(
+            runner,
+            max_batch=engine.buckets[-1],
+            max_queue=cfg.queue_depth,
+            max_delay_ms=cfg.max_delay_ms,
+            on_batch=on_batch,
+        )
+
+        # a small pregenerated pool of deterministic samples, cycled —
+        # the offered traffic is seed-reproducible (and identical on
+        # both A/B sides) without allocating thousands of images
+        rng = np.random.default_rng(cfg.seed)
+        pool = rng.standard_normal(
+            (32, engine.image_size, engine.image_size, 3)
+        ).astype(np.float32)
+        sample_fn = lambda i: pool[i % len(pool)]
+
+        gen = LoadGenerator(
+            batcher.submit,
+            sample_fn,
+            mode=cfg.mode,
+            requests=cfg.requests,
+            rate=cfg.rate,
+            concurrency=cfg.concurrency,
+            seed=cfg.seed,
+            stop_fn=lambda: handler.preempted,
+        )
+        raw = gen.run()
+        # graceful drain: accepted requests are all answered before
+        # the verdict is written — on SIGTERM this is the whole point
+        drained_clean = batcher.drain(timeout=120.0)
+        wall = max(raw["wall_s"], 1e-9)
+        passes[label] = {
+            "raw": raw,
+            "batcher_stats": batcher.stats(),
+            "drained_clean": drained_clean,
+            "warmup_s": warmup_s,
+            "residency": residency,
+            "step_ms": step_ms,
+            "throughput_rps": round(raw["completed"] / wall, 3),
+            "p99_ms": _pct(raw["latencies_ms"], 99.0),
+        }
+        # keep only the provenance scalars, then drop EVERY reference
+        # that reaches the engine — the engine local, the runner whose
+        # default arg captured it, and the batcher/gen that hold the
+        # runner: the next pass builds its own engine, and an A/B must
+        # not hold both resident sets at once (a surviving reference
+        # would pin the dense weights through the packed pass's
+        # construction and warmup — that overlap is the bug the A/B
+        # exists to measure)
+        engine_meta = _EngineMeta(engine.arch, engine.dataset)
+        del engine, runner, batcher, gen
+
+    primary = passes.get("packed") or passes["dense"]
+
+    packed_block = None
+    if cfg.packed_weights != "off":
+        sides = {}
+        for label in ("dense", "packed"):
+            p = passes.get(label)
+            if p is None:
+                # a side that never ran (packed-only mode, or an ab
+                # run preempted between passes) still records its
+                # resident footprint computed from the OTHER side's
+                # tensor index — dense-equivalent for a missing dense
+                # pass, packed-equivalent for a missing packed pass
+                # (filling the packed side with dense bytes would
+                # report resident_ratio ~1.0, as if packing bought
+                # nothing) — so the squeeze stays visible without the
+                # double run
+                pr = primary["residency"]
+                equiv_key = (
+                    "dense_equiv_bytes" if label == "dense"
+                    else "packed_equiv_bytes"
+                )
+                sides[label] = {
+                    "resident_bytes": pr[equiv_key],
+                    "step_ms": None,
+                    "throughput_rps": None,
+                    "p99_ms": None,
+                }
+                continue
+            sides[label] = {
+                "resident_bytes": p["residency"]["resident_bytes"],
+                "step_ms": p["step_ms"],
+                "throughput_rps": p["throughput_rps"],
+                "p99_ms": p["p99_ms"],
+            }
+        d_bytes = sides["dense"]["resident_bytes"]
+        p_bytes = sides["packed"]["resident_bytes"]
+        d_ms, p_ms = sides["dense"]["step_ms"], sides["packed"]["step_ms"]
+        packed_block = {
+            "mode": cfg.packed_weights,
+            "impl": cfg.packed_impl,
+            "dense": sides["dense"],
+            "packed": sides["packed"],
+            "resident_ratio": (
+                round(d_bytes / max(p_bytes, 1), 3)
+                if d_bytes is not None and p_bytes is not None else None
+            ),
+            "step_ms_delta_pct": (
+                round((p_ms - d_ms) / d_ms * 100.0, 2)
+                if d_ms and p_ms is not None else None
+            ),
+        }
+
+    from bdbnn_tpu.serve.pool import single_engine_resident_block
+
+    resident = single_engine_resident_block(
+        primary["residency"], completed=primary["raw"]["completed"]
     )
-
-    # a small pregenerated pool of deterministic samples, cycled — the
-    # offered traffic is seed-reproducible without allocating thousands
-    # of images
-    rng = np.random.default_rng(cfg.seed)
-    pool = rng.standard_normal(
-        (32, engine.image_size, engine.image_size, 3)
-    ).astype(np.float32)
-    sample_fn = lambda i: pool[i % len(pool)]
-
-    gen = LoadGenerator(
-        batcher.submit,
-        sample_fn,
-        mode=cfg.mode,
-        requests=cfg.requests,
-        rate=cfg.rate,
-        concurrency=cfg.concurrency,
-        seed=cfg.seed,
-        stop_fn=lambda: handler.preempted,
-    )
-    raw = gen.run()
-    preempted = handler.preempted
-    # graceful drain: accepted requests are all answered before the
-    # verdict is written — on SIGTERM this is the whole point
-    drained_clean = batcher.drain(timeout=120.0)
 
     verdict = slo_verdict(
-        raw,
-        batcher.stats(),
+        primary["raw"],
+        primary["batcher_stats"],
         mode=cfg.mode,
         rate=cfg.rate,
         seed=cfg.seed,
         provenance=_serve_provenance(
-            cfg.artifact, engine, prov, recipe, manifest
+            cfg.artifact, engine_meta, prov, recipe, manifest
         ),
-        warmup_s=warmup_s,
-        preempted=preempted,
-        drained_clean=drained_clean,
+        warmup_s=primary["warmup_s"],
+        preempted=handler.preempted,
+        drained_clean=all(p["drained_clean"] for p in passes.values()),
+        resident=resident,
+        packed=packed_block,
     )
     events.emit("serve", phase="verdict", **verdict)
     events.close()

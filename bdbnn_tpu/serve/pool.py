@@ -901,6 +901,204 @@ def replica_stats_fields(ps: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+class ResidentModelCache:
+    """N packed artifacts co-resident on ONE replica device, with LRU
+    accounting — the multi-tenant unlock the 1-bit residency buys: a
+    packed resnet is ~16-32x smaller on the conv weights, so one chip
+    holds dozens of models and ``serve-http`` can route ``x-model`` to
+    co-resident versions without a reload in the request path.
+
+    ``loader(model_key) -> engine`` builds (and AOT-warms) one model's
+    engine on this replica's device; ``capacity`` bounds how many stay
+    resident. ``get`` returns the resident engine, loading on first
+    use and evicting the least-recently-used OTHER model when the
+    cache is full (the evicted engine's device buffers free when the
+    reference drops). Every load/hit/eviction is counted and each
+    model's resident bytes recorded — the verdict's ``resident`` block
+    and the ``memory`` serve events read :meth:`stats`.
+
+    Thread-safe: one replica worker owns the request path, but swap
+    factories, admin stats reads and the verdict assembly may look in
+    concurrently."""
+
+    def __init__(
+        self,
+        loader: Callable[[str], Any],
+        *,
+        capacity: int = 1,
+        device: str = "",
+        on_event: Optional[Callable[..., Any]] = None,
+    ):
+        if capacity < 1:
+            raise ValueError("resident-model capacity must be >= 1")
+        self.loader = loader
+        self.capacity = int(capacity)
+        self.device = str(device)
+        self.on_event = on_event
+        self._lock = threading.Lock()
+        # insertion/refresh order IS the LRU order (oldest first)
+        self._engines: "dict[str, Any]" = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.loads = 0
+        self.load_seconds: Dict[str, float] = {}
+        self.resident_bytes: Dict[str, int] = {}
+        self.dense_equiv_bytes: Dict[str, int] = {}
+
+    def get(self, key: str):
+        """The resident engine for ``key`` — loading it (and evicting
+        the LRU resident if the cache is full) on first use. The load
+        happens OUTSIDE the lock: a cold model compiling for seconds
+        must not block stats reads, and the worst double-load race
+        costs one redundant build, never a wrong answer."""
+        key = str(key)
+        with self._lock:
+            engine = self._engines.pop(key, None)
+            if engine is not None:
+                self._engines[key] = engine  # refresh LRU position
+                self.hits += 1
+                return engine
+            self.misses += 1
+        t0 = time.monotonic()
+        engine = self.loader(key)
+        load_s = round(time.monotonic() - t0, 3)
+        report = self._engine_residency(engine)
+        nbytes = report.get("resident_bytes") if report else None
+        with self._lock:
+            if key not in self._engines:
+                while len(self._engines) >= self.capacity:
+                    old_key = next(iter(self._engines))
+                    self._engines.pop(old_key)
+                    self.evictions += 1
+                    # the byte accounting tracks what is resident NOW
+                    # — an evicted model's row must leave with its
+                    # engine, or stats()/resident_block report freed
+                    # device memory as still occupied
+                    evicted_bytes = self.resident_bytes.pop(
+                        old_key, None
+                    )
+                    self.dense_equiv_bytes.pop(old_key, None)
+                    self.load_seconds.pop(old_key, None)
+                    self._emit(
+                        "replica", phase="model_evict", device=self.device,
+                        model=old_key,
+                        resident_bytes=evicted_bytes,
+                    )
+                self._engines[key] = engine
+                self.loads += 1
+                self.load_seconds[key] = load_s
+                if nbytes is not None:
+                    self.resident_bytes[key] = nbytes
+                if report and report.get("dense_equiv_bytes") is not None:
+                    self.dense_equiv_bytes[key] = int(
+                        report["dense_equiv_bytes"]
+                    )
+                self._emit(
+                    "replica", phase="model_load", device=self.device,
+                    model=key, seconds=load_s, resident_bytes=nbytes,
+                )
+            return self._engines[key]
+
+    @staticmethod
+    def _engine_residency(engine) -> Optional[Dict[str, Any]]:
+        residency = getattr(engine, "residency", None)
+        if callable(residency):
+            try:
+                return residency()
+            except Exception:
+                return None
+        return None
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(kind, **fields)
+            except Exception:
+                pass  # telemetry must never break the request path
+
+    def resident_keys(self) -> List[str]:
+        with self._lock:
+            return list(self._engines)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "device": self.device,
+                "capacity": self.capacity,
+                "resident": list(self._engines),
+                "hits": self.hits,
+                "misses": self.misses,
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "load_seconds": dict(self.load_seconds),
+                "resident_bytes": dict(self.resident_bytes),
+                "dense_equiv_bytes": dict(self.dense_equiv_bytes),
+            }
+
+
+def resident_block(
+    caches: Sequence["ResidentModelCache"],
+    *,
+    completed_by_model: Optional[Dict[str, int]] = None,
+) -> Optional[Dict[str, Any]]:
+    """The verdict's ``resident`` block over every replica's model
+    cache: per-model resident bytes (max over replicas — the binding
+    per-chip figure), load/hit/eviction totals, and — when the front
+    end tracked it — completed requests per model. None when no cache
+    exists (dense single-engine serving), so pre-packed verdicts skip
+    cleanly in ``compare``."""
+    if not caches:
+        return None
+    models: Dict[str, Dict[str, Any]] = {}
+    hits = misses = evictions = loads = 0
+
+    def _row(key):
+        return models.setdefault(
+            key,
+            {
+                "resident_bytes": None,
+                "dense_equiv_bytes": None,
+                "completed": None,
+            },
+        )
+
+    for c in caches:
+        s = c.stats()
+        hits += s["hits"]
+        misses += s["misses"]
+        evictions += s["evictions"]
+        loads += s["loads"]
+        for key, nbytes in s["resident_bytes"].items():
+            row = _row(key)
+            if nbytes is not None:
+                row["resident_bytes"] = max(
+                    row["resident_bytes"] or 0, int(nbytes)
+                )
+        for key, nbytes in s["dense_equiv_bytes"].items():
+            row = _row(key)
+            if nbytes is not None:
+                row["dense_equiv_bytes"] = max(
+                    row["dense_equiv_bytes"] or 0, int(nbytes)
+                )
+    for key, n in (completed_by_model or {}).items():
+        _row(key)["completed"] = int(n)
+    per_model = [
+        b for b in (m["resident_bytes"] for m in models.values())
+        if b is not None
+    ]
+    return {
+        "capacity": max(c.capacity for c in caches),
+        "replicas": len(caches),
+        "models": models,
+        "hits": hits,
+        "misses": misses,
+        "loads": loads,
+        "evictions": evictions,
+        "bytes_per_model_max": max(per_model) if per_model else None,
+    }
+
+
 def first_warm_capture():
     """``(warm_compile, on_engine)`` pair for
     :func:`make_engine_runner_factory`: records only the FIRST replica
@@ -917,16 +1115,62 @@ def first_warm_capture():
     return warm_compile, on_engine
 
 
+DEFAULT_MODEL = "default"
+
+
+def single_engine_resident_block(
+    residency: Dict[str, Any], *, completed: Optional[int] = None
+) -> Dict[str, Any]:
+    """The verdict's ``resident`` block for the single-engine serving
+    paths (no pool, no cache): ONE model, the engine's own
+    :meth:`~bdbnn_tpu.serve.engine.InferenceEngine.residency` report.
+    Same shape :func:`resident_block` emits, built here once so the
+    serve-bench and serve-http verdicts cannot drift apart."""
+    return {
+        "capacity": 1,
+        "replicas": 1,
+        "models": {
+            DEFAULT_MODEL: {
+                "resident_bytes": residency["resident_bytes"],
+                "dense_equiv_bytes": residency["dense_equiv_bytes"],
+                "completed": completed,
+            }
+        },
+        "hits": None,
+        "misses": None,
+        "loads": 1,
+        "evictions": 0,
+        "bytes_per_model_max": residency["resident_bytes"],
+    }
+
+
 def make_engine_runner_factory(
     buckets: Sequence[int],
     *,
     pace_ms: float = 0.0,
     on_engine: Optional[Callable[[Any, Any], None]] = None,
+    packed: bool = False,
+    packed_impl: str = "unpack",
+    resident_models: int = 1,
+    model_dirs: Optional[Dict[str, str]] = None,
+    on_event: Optional[Callable[..., Any]] = None,
 ) -> Callable[[str, Any], Callable[[List[Any]], Any]]:
     """The real runner factory: ``factory(artifact_dir, device) ->
     runner`` builds an :class:`~bdbnn_tpu.serve.engine.InferenceEngine`
     with its weights placed and its buckets AOT-warmed on that device,
     and returns its batched-predict callable.
+
+    ``packed=True`` keeps the weights 1-bit resident (engine
+    ``packed`` mode, nn/packed.py). ``resident_models > 1`` puts a
+    :class:`ResidentModelCache` of that capacity behind each replica:
+    payloads may then be ``(model_key, image)`` tuples — the
+    ``x-model``-routed multi-model path — and the runner groups each
+    coalesced batch by model, answers every group from its co-resident
+    engine, and reassembles results in arrival order. ``model_dirs``
+    maps model keys to artifact dirs (``DEFAULT_MODEL`` falls back to
+    the factory's own ``artifact_dir`` argument). Every cache built is
+    appended to ``factory.caches`` so the orchestration can assemble
+    the verdict's ``resident`` block.
 
     ``pace_ms > 0`` swaps the engine's compute for a fixed sleep per
     batch (weights never load, nothing compiles): the serving-fabric
@@ -940,6 +1184,7 @@ def make_engine_runner_factory(
     import numpy as np
 
     pace_s = float(pace_ms) / 1000.0
+    caches: List[ResidentModelCache] = []
 
     def factory(artifact_dir: str, device):
         if pace_s > 0:
@@ -951,21 +1196,65 @@ def make_engine_runner_factory(
             return paced
         from bdbnn_tpu.serve.engine import InferenceEngine
 
-        engine = InferenceEngine(
-            artifact_dir, buckets=buckets, device=device
+        def load_model(key: str):
+            path = (model_dirs or {}).get(key)
+            if path is None:
+                if key != DEFAULT_MODEL:
+                    raise KeyError(f"unknown model key {key!r}")
+                path = artifact_dir
+            engine = InferenceEngine(
+                path, buckets=buckets, device=device,
+                packed=packed, packed_impl=packed_impl,
+            )
+            if on_engine is not None:
+                on_engine(engine, device)  # warmup-seconds hook
+            return engine
+
+        cache = ResidentModelCache(
+            load_model,
+            capacity=max(int(resident_models), 1),
+            device=str(device),
+            on_event=on_event,
         )
-        if on_engine is not None:
-            on_engine(engine, device)  # warmup-seconds reporting hook
+        # one LIVE cache per device: a blue/green swap calls the
+        # factory again for the same device, and the retired runner's
+        # cache must leave the list with it — keeping it would pin the
+        # old version's engines (device weights never freed) and make
+        # resident_block aggregate dead caches into the verdict
+        for stale in [c for c in caches if c.device == str(device)]:
+            caches.remove(stale)
+        caches.append(cache)
+        cache.get(DEFAULT_MODEL)  # the default model warms eagerly
 
         def runner(payloads: List[Any]):
-            return engine.predict_logits(np.stack(payloads))
+            # multi-model path: (model_key, image) tuples grouped by
+            # key, each group answered by its co-resident engine, the
+            # results reassembled in arrival order
+            if payloads and isinstance(payloads[0], tuple):
+                groups: Dict[str, List[int]] = {}
+                for idx, (key, _img) in enumerate(payloads):
+                    groups.setdefault(key or DEFAULT_MODEL, []).append(idx)
+                results: List[Any] = [None] * len(payloads)
+                for key, idxs in groups.items():
+                    engine = cache.get(key)
+                    logits = engine.predict_logits(
+                        np.stack([payloads[i][1] for i in idxs])
+                    )
+                    for row, i in enumerate(idxs):
+                        results[i] = logits[row]
+                return results
+            return cache.get(DEFAULT_MODEL).predict_logits(
+                np.stack(payloads)
+            )
 
         return runner
 
+    factory.caches = caches
     return factory
 
 
 __all__ = [
+    "DEFAULT_MODEL",
     "READY",
     "SHIFTING",
     "STOPPED",
@@ -979,7 +1268,10 @@ __all__ = [
     "PoolAdmin",
     "Replica",
     "ReplicaPool",
+    "ResidentModelCache",
     "first_warm_capture",
     "make_engine_runner_factory",
     "replica_stats_fields",
+    "resident_block",
+    "single_engine_resident_block",
 ]

@@ -93,6 +93,58 @@ def rng():
 
 
 # ---------------------------------------------------------------------------
+# Tier-1 wall-budget guard: the ROADMAP command runs the not-slow tier
+# under `timeout -k 10 870`; drifting past that used to be discovered
+# only as a mid-run SIGKILL with zero diagnostics. This guard FAILS the
+# suite (with a rebalance hint) as soon as a green not-slow run exceeds
+# the soft budget below, so budget drift is a red test with a message,
+# never a timeout autopsy. Scoped to `-m 'not slow'` invocations only —
+# full/slow runs and small -k selections are not the tier-1 shape.
+# ---------------------------------------------------------------------------
+
+TIER1_WALL_BUDGET_S = float(os.environ.get("TIER1_WALL_BUDGET_S", "850"))
+
+
+def pytest_sessionstart(session):
+    import time
+
+    session.config._tier1_wall_t0 = time.monotonic()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import time
+
+    t0 = getattr(session.config, "_tier1_wall_t0", None)
+    if t0 is None:
+        return
+    try:
+        markexpr = session.config.getoption("markexpr") or ""
+    except Exception:
+        return
+    if "not slow" not in markexpr:
+        return
+    elapsed = time.monotonic() - t0
+    if elapsed <= TIER1_WALL_BUDGET_S:
+        return
+    msg = (
+        f"\nTIER-1 WALL BUDGET EXCEEDED: {elapsed:.0f}s > "
+        f"{TIER1_WALL_BUDGET_S:.0f}s soft budget (hard timeout 870s).\n"
+        "Rebalance before the driver starts SIGKILLing mid-run: move "
+        "the broadest e2e smokes whose logic has denser unit/fault "
+        "coverage to the `slow` tier (PR 6/7/8 precedent — fit() "
+        "smokes, soak tests, heavy per-arch matrix tails), or raise "
+        "TIER1_WALL_BUDGET_S explicitly if the box is known-slow."
+    )
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    if tr is not None:
+        tr.write_line(msg, red=True)
+    else:
+        print(msg)
+    if session.exitstatus == 0:
+        session.exitstatus = 1
+
+
+# ---------------------------------------------------------------------------
 # Simulated-device subprocess harness: one place that knows how to pin a
 # FRESH python process to its own --xla_force_host_platform_device_count
 # (the tests/pod_worker.py env recipe), shared by the reshard tests

@@ -1,0 +1,1008 @@
+"""True 1-bit inference tests: packed weights resident on device with
+fused on-the-fly unpack (nn/packed.py, serve/engine.py packed mode,
+serve/pool.py ResidentModelCache, the serve-bench packed-vs-dense A/B
+and the serve-http x-model multi-model path).
+
+The load-bearing contract everywhere: packed-mode logits are BITWISE
+equal to dense-mode logits — the unpack (``unpackbits -> ±1 -> *alpha``)
+is exact in f32 and feeds the identical binarize+conv subgraph, and the
+popcount dot computes the same exact small integers the f32 conv does.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from bdbnn_tpu.serve.export import _file_sha256, _pack_sign, unpack_sign
+
+
+# ---------------------------------------------------------------------------
+# packbits round trip at odd sizes (remainder bits) — host and device
+# ---------------------------------------------------------------------------
+
+
+class TestPackBits:
+    @pytest.mark.parametrize(
+        "shape",
+        [(3, 3, 3, 3), (1, 1, 5, 5), (3, 3, 8, 8), (2, 2, 1, 1)],
+        ids=["81w", "25w", "576w", "4w"],
+    )
+    def test_host_round_trip_any_remainder(self, shape, rng):
+        """packbits pads the final byte with zero bits; unpack must
+        strip exactly the remainder — a flattened weight count that is
+        NOT a multiple of 8 (81, 25) reconstructs bitwise."""
+        w = rng.normal(size=shape).astype(np.float32)
+        sign = unpack_sign(_pack_sign(w), shape)
+        expect = np.where(w >= 0, 1.0, -1.0).astype(np.float32)
+        np.testing.assert_array_equal(sign, expect)
+
+    @pytest.mark.parametrize("shape", [(3, 3, 3, 3), (1, 1, 5, 5)])
+    def test_device_unpack_bitwise_matches_host(self, shape, rng):
+        """The jnp twin (the thing fused into the jitted forward)
+        reconstructs bitwise what the host loader reconstructs — at
+        odd weight counts where the remainder-bit slice matters."""
+        import jax
+
+        from bdbnn_tpu.nn.packed import (
+            packed_dense_weight,
+            unpack_sign_device,
+        )
+
+        w = rng.normal(size=shape).astype(np.float32)
+        packed = _pack_sign(w)
+        alpha = np.mean(np.abs(w), axis=(0, 1, 2)).astype(np.float32)
+        host_sign = unpack_sign(packed, shape)
+        dev_sign = np.asarray(
+            jax.jit(lambda p: unpack_sign_device(p, shape))(packed)
+        )
+        np.testing.assert_array_equal(dev_sign, host_sign)
+        dev_w = np.asarray(
+            jax.jit(lambda p, a: packed_dense_weight(p, a, shape))(
+                packed, alpha
+            )
+        )
+        np.testing.assert_array_equal(dev_w, host_sign * alpha)
+
+
+# ---------------------------------------------------------------------------
+# export <-> engine round trip at odd channel counts: a hand-built
+# artifact whose binary conv has 81 weights (7 remainder bits in the
+# final byte) must reconstruct bitwise through BOTH loaders
+# ---------------------------------------------------------------------------
+
+
+def _write_mini_artifact(out_dir, tensors):
+    """A minimal artifact dir in the exact export format: weights.npz
+    with sign:/alpha:/dense: keys + artifact.json carrying the tensor
+    index, bn_folded and the weights digest (what the loaders read)."""
+    os.makedirs(out_dir, exist_ok=True)
+    arrays = {}
+    index = []
+    for path, leaf in tensors:
+        leaf = np.asarray(leaf, np.float32)
+        if path.endswith("float_weight"):
+            base = path.rsplit("/", 1)[0]
+            arrays[f"sign:{base}"] = _pack_sign(leaf)
+            arrays[f"alpha:{base}"] = np.mean(
+                np.abs(leaf), axis=tuple(range(leaf.ndim - 1))
+            ).astype(np.float32)
+            index.append({
+                "path": base,
+                "kind": "binary",
+                "shape": list(leaf.shape),
+                "dtype": "1bit+f32alpha",
+            })
+        else:
+            arrays[f"dense:{path}"] = leaf
+            index.append({
+                "path": path,
+                "kind": "dense",
+                "shape": list(leaf.shape),
+                "dtype": "float32",
+            })
+    wpath = os.path.join(out_dir, "weights.npz")
+    with open(wpath, "wb") as f:
+        np.savez(f, **arrays)
+    artifact = {
+        "schema": 1,
+        "tensors": index,
+        "bn_folded": [],
+        "weights_sha256": _file_sha256(wpath),
+    }
+    with open(os.path.join(out_dir, "artifact.json"), "w") as f:
+        json.dump(artifact, f)
+    return out_dir
+
+
+class TestOddChannelRoundTrip:
+    def test_loaders_reconstruct_bitwise(self, tmp_path, rng):
+        """81- and 25-weight binary convs (flattened counts not
+        divisible by 8) round-trip export-format -> dense loader AND
+        export-format -> packed loader -> device unpack, all bitwise
+        equal to sign*alpha of the original latent weights."""
+        import jax
+
+        from bdbnn_tpu.nn.packed import packed_dense_weight
+        from bdbnn_tpu.serve.export import (
+            load_artifact_packed,
+            load_artifact_variables,
+        )
+
+        w_a = rng.normal(size=(3, 3, 3, 3)).astype(np.float32)
+        w_b = rng.normal(size=(1, 1, 5, 5)).astype(np.float32)
+        dense = rng.normal(size=(7,)).astype(np.float32)
+        art = _write_mini_artifact(
+            str(tmp_path / "art"),
+            [
+                ("blk/conv_odd/float_weight", w_a),
+                ("blk/conv_tiny/float_weight", w_b),
+                ("head/bias", dense),
+            ],
+        )
+        expected = {
+            "conv_odd": (
+                np.where(w_a >= 0, 1.0, -1.0).astype(np.float32)
+                * np.mean(np.abs(w_a), axis=(0, 1, 2)).astype(np.float32)
+            ),
+            "conv_tiny": (
+                np.where(w_b >= 0, 1.0, -1.0).astype(np.float32)
+                * np.mean(np.abs(w_b), axis=(0, 1, 2)).astype(np.float32)
+            ),
+        }
+
+        # dense loader
+        variables = load_artifact_variables(art)
+        for name, want in expected.items():
+            np.testing.assert_array_equal(
+                variables["params"]["blk"][name]["float_weight"], want
+            )
+        np.testing.assert_array_equal(
+            variables["params"]["head"]["bias"], dense
+        )
+
+        # packed loader + device reconstruction
+        packed_vars, spec = load_artifact_packed(art)
+        assert "float_weight" not in str(packed_vars["params"])
+        assert {b["path"] for b in spec["binary"]} == {
+            "blk/conv_odd", "blk/conv_tiny",
+        }
+        for name, want in expected.items():
+            node = packed_vars["packed"]["blk"][name]
+            got = np.asarray(
+                jax.jit(
+                    lambda p, a, s=want.shape: packed_dense_weight(
+                        p, a, s
+                    )
+                )(node["sign"], node["alpha"])
+            )
+            np.testing.assert_array_equal(got, want)
+        # the squeeze is real even at odd sizes: 81 f32 weights -> 11
+        # packed bytes + 3 alphas
+        row = next(
+            b for b in spec["binary"] if b["path"] == "blk/conv_odd"
+        )
+        assert row["packed_bytes"] == 11 + 3 * 4
+        assert row["dense_bytes"] == 81 * 4
+
+    def test_torn_weights_fail_packed_loader_too(self, tmp_path, rng):
+        from bdbnn_tpu.serve.export import load_artifact_packed
+
+        art = _write_mini_artifact(
+            str(tmp_path / "art"),
+            [("blk/c/float_weight", rng.normal(size=(3, 3, 3, 3)))],
+        )
+        with open(os.path.join(art, "weights.npz"), "ab") as f:
+            f.write(b"\0" * 8)
+        with pytest.raises(RuntimeError, match="sha256"):
+            load_artifact_packed(art)
+
+
+# ---------------------------------------------------------------------------
+# packed-apply bitwise equality across the registry (the acceptance
+# matrix): eval_shape-seeded params, folded BN, host-packed binary
+# convs — jitted packed apply must equal jitted dense apply BITWISE
+# ---------------------------------------------------------------------------
+
+# tier-1 keeps one member of every equivalence class (cifar/imagenet
+# stem, plain/react/step2 variants, vgg topology); the depth/duplicate
+# tail runs under `slow`, mirroring the fold-matrix split
+_PACKED_CASES = [
+    ("cifar10", "resnet8_tiny", []),
+    ("cifar10", "resnet18_react", []),
+    ("cifar10", "vgg_small", []),
+    ("imagenet", "resnet18_react", []),
+    ("imagenet", "resnet18_step2", []),
+    ("cifar10", "resnet20", [pytest.mark.slow]),
+    ("cifar10", "resnet18", [pytest.mark.slow]),
+    ("cifar10", "resnet20_react", [pytest.mark.slow]),
+    ("cifar10", "resnet34", [pytest.mark.slow]),
+    ("imagenet", "resnet18", [pytest.mark.slow]),
+    ("imagenet", "resnet34_react", [pytest.mark.slow]),
+    ("imagenet", "resnet34_step2", [pytest.mark.slow]),
+]
+
+
+def _packed_variables(dataset, arch, seed=2):
+    """(model, dense_variables, packed_variables, n_binary): fold BN,
+    then pack every binary conv to the artifact representation — dense
+    variables carry the reconstructed sign*alpha float_weight, packed
+    variables carry the 1-bit payload in the `packed` collection and
+    NO float_weight param."""
+    import jax
+    import jax.numpy as jnp
+
+    from bdbnn_tpu.models.registry import create_model
+    from bdbnn_tpu.models.resnet import fold_batch_norm
+
+    model = create_model(arch, dataset)
+    shapes = jax.eval_shape(
+        lambda rng: model.init(
+            rng, jnp.zeros((1, 16, 16, 3)), train=False
+        ),
+        jax.random.PRNGKey(0),
+    )
+    prng = np.random.default_rng(seed)
+    params = jax.tree_util.tree_map(
+        lambda sd: prng.normal(0, 0.1, sd.shape).astype(sd.dtype),
+        shapes["params"],
+    )
+    stats = jax.tree_util.tree_map(
+        lambda sd: np.zeros(sd.shape, sd.dtype),
+        shapes.get("batch_stats", {}),
+    )
+    variables = fold_batch_norm({"params": params, "batch_stats": stats})
+
+    def set_path(tree, path, leaf):
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = leaf
+
+    dense_params, packed_params, packed = {}, {}, {}
+    n_binary = 0
+
+    def walk(node, prefix=()):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], prefix + (k,))
+            return
+        nonlocal n_binary
+        leaf = np.asarray(node)
+        if prefix[-1] == "float_weight" and leaf.ndim == 4:
+            alpha = np.mean(
+                np.abs(leaf.astype(np.float32)), axis=(0, 1, 2)
+            ).astype(np.float32)
+            pk = _pack_sign(leaf)
+            sign = unpack_sign(pk, leaf.shape)
+            set_path(dense_params, prefix, sign * alpha)
+            set_path(packed, prefix[:-1] + ("sign",), pk)
+            set_path(packed, prefix[:-1] + ("alpha",), alpha)
+            n_binary += 1
+        else:
+            set_path(dense_params, prefix, leaf)
+            set_path(packed_params, prefix, leaf)
+
+    walk(variables["params"])
+    dense_vars = {
+        "params": dense_params, "batch_stats": variables["batch_stats"],
+    }
+    packed_vars = {
+        "params": packed_params,
+        "batch_stats": variables["batch_stats"],
+        "packed": packed,
+    }
+    return model, dense_vars, packed_vars, n_binary
+
+
+class TestPackedApplyBitwise:
+    @pytest.mark.parametrize(
+        "dataset,arch",
+        [
+            pytest.param(d, a, marks=marks)
+            for d, a, marks in _PACKED_CASES
+        ],
+        ids=[f"{d}-{a}" for d, a, _ in _PACKED_CASES],
+    )
+    def test_packed_equals_dense_bitwise(self, dataset, arch):
+        """THE acceptance pin: for every registry arch, the jitted
+        packed-apply forward (1-bit resident, transient unpack) yields
+        logits bitwise-equal to the jitted dense forward."""
+        import jax
+
+        model, dense_vars, packed_vars, n_binary = _packed_variables(
+            dataset, arch
+        )
+        assert n_binary > 0, "matrix case has no binary convs"
+        x = np.random.default_rng(0).normal(
+            size=(2, 16, 16, 3)
+        ).astype(np.float32)
+        apply = lambda v, x: model.apply(v, x, train=False)
+        ref = np.asarray(jax.jit(apply)(dense_vars, x))
+        got = np.asarray(jax.jit(apply)(packed_vars, x))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_float_arch_packed_collection_is_noop(self):
+        """A float twin has no binary convs: an empty packed collection
+        must change nothing (and the packed loader path stays total)."""
+        import jax
+
+        from bdbnn_tpu.models.registry import create_model
+        from bdbnn_tpu.models.resnet import fold_batch_norm
+
+        model = create_model("resnet20_float", "cifar10")
+        import jax.numpy as jnp
+
+        shapes = jax.eval_shape(
+            lambda rng: model.init(
+                rng, jnp.zeros((1, 16, 16, 3)), train=False
+            ),
+            jax.random.PRNGKey(0),
+        )
+        prng = np.random.default_rng(3)
+        params = jax.tree_util.tree_map(
+            lambda sd: prng.normal(0, 0.1, sd.shape).astype(sd.dtype),
+            shapes["params"],
+        )
+        stats = jax.tree_util.tree_map(
+            lambda sd: np.zeros(sd.shape, sd.dtype),
+            shapes.get("batch_stats", {}),
+        )
+        variables = fold_batch_norm(
+            {"params": params, "batch_stats": stats}
+        )
+        x = np.random.default_rng(0).normal(
+            size=(1, 16, 16, 3)
+        ).astype(np.float32)
+        apply = lambda v, x: model.apply(v, x, train=False)
+        ref = np.asarray(jax.jit(apply)(variables, x))
+        got = np.asarray(
+            jax.jit(apply)({**variables, "packed": {}}, x)
+        )
+        np.testing.assert_array_equal(got, ref)
+
+
+class TestPopcountImpl:
+    @pytest.mark.parametrize(
+        "shape,strides",
+        [
+            ((3, 3, 5, 4), (1, 1)),   # odd K = 45: remainder lanes
+            ((3, 3, 8, 8), (2, 2)),   # strided
+            ((1, 1, 7, 3), (1, 1)),   # 1x1, odd channels
+        ],
+        ids=["k45", "strided", "1x1-k7"],
+    )
+    def test_popcount_matches_xla_conv_bitwise(self, shape, strides, rng):
+        """The XNOR-popcount dot computes the exact integers the f32
+        conv on ±1 operands accumulates — masked correctly through the
+        zero-padding lanes — so the two paths agree BITWISE."""
+        import jax
+
+        from bdbnn_tpu.nn.kernels import binary_conv2d_mxu
+        from bdbnn_tpu.nn.packed import popcount_binary_conv
+
+        xb = np.where(
+            rng.normal(size=(2, 9, 9, shape[2])) >= 0, 1.0, -1.0
+        ).astype(np.float32)
+        wb = np.where(
+            rng.normal(size=shape) >= 0, 1.0, -1.0
+        ).astype(np.float32)
+        alpha = rng.uniform(0.1, 2.0, shape[-1]).astype(np.float32)
+        ref = np.asarray(
+            jax.jit(
+                lambda x, w, a: binary_conv2d_mxu(
+                    x, w, a, strides=strides
+                )
+            )(xb, wb, alpha)
+        )
+        got = np.asarray(
+            jax.jit(
+                lambda x, w, a: popcount_binary_conv(
+                    x, w, a, strides=strides
+                )
+            )(xb, wb, alpha)
+        )
+        np.testing.assert_array_equal(got, ref)
+
+    def test_full_model_popcount_bitwise(self):
+        """resnet8_tiny end-to-end with the popcount impl bound at
+        trace time: logits bitwise-equal to the dense forward."""
+        import jax
+
+        from bdbnn_tpu.nn.packed import packed_impl
+
+        model, dense_vars, packed_vars, _ = _packed_variables(
+            "cifar10", "resnet8_tiny"
+        )
+        x = np.random.default_rng(1).normal(
+            size=(2, 16, 16, 3)
+        ).astype(np.float32)
+        apply = lambda v, x: model.apply(v, x, train=False)
+        ref = np.asarray(jax.jit(apply)(dense_vars, x))
+        with packed_impl("popcount"):
+            got = np.asarray(jax.jit(apply)(packed_vars, x))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_bf16_rejected(self):
+        from bdbnn_tpu.nn.packed import popcount_binary_conv
+        import jax.numpy as jnp
+
+        xb = jnp.ones((1, 4, 4, 8), jnp.bfloat16)
+        wb = jnp.ones((3, 3, 8, 4), jnp.bfloat16)
+        with pytest.raises(ValueError, match="float32"):
+            popcount_binary_conv(xb, wb, jnp.ones((4,)))
+
+    def test_unknown_impl_rejected(self):
+        from bdbnn_tpu.nn.packed import set_packed_impl
+
+        with pytest.raises(ValueError, match="unpack"):
+            set_packed_impl("int8")
+
+
+# ---------------------------------------------------------------------------
+# engine packed mode over the REAL exported artifact (session fixture)
+# ---------------------------------------------------------------------------
+
+
+class TestPackedEngine:
+    def test_packed_logits_bitwise_and_residency(self, exported_artifact):
+        """The engine-level round trip: a packed engine answers every
+        request size (padding + chunk seam included) with logits
+        bitwise-equal to the dense engine, while its resident weight
+        bytes shrink >= 4x vs the dense-equivalent footprint."""
+        from bdbnn_tpu.serve.engine import InferenceEngine
+
+        art_dir, _ = exported_artifact
+        dense = InferenceEngine(art_dir, buckets=(1, 4))
+        packed = InferenceEngine(art_dir, buckets=(1, 4), packed=True)
+        rng = np.random.default_rng(11)
+        for n in (1, 3, 4, 5, 11):
+            x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+            np.testing.assert_array_equal(
+                packed.predict_logits(x), dense.predict_logits(x)
+            )
+        r = packed.residency()
+        assert r["packed"] is True
+        assert r["resident_bytes"] < r["dense_equiv_bytes"]
+        # the acceptance gate: >= 4x artifact-level squeeze on the
+        # resident set (resnet8_tiny measures ~7x)
+        assert r["ratio"] >= 4.0
+        # the dense engine agrees about the counterfactual
+        rd = dense.residency()
+        assert rd["packed"] is False
+        assert rd["resident_bytes"] == r["dense_equiv_bytes"]
+        assert rd["packed_equiv_bytes"] == r["resident_bytes"]
+        assert packed.time_step(bucket=4, iters=2) > 0.0
+
+    def test_popcount_engine_bitwise(self, exported_artifact):
+        from bdbnn_tpu.serve.engine import InferenceEngine
+
+        art_dir, _ = exported_artifact
+        dense = InferenceEngine(art_dir, buckets=(4,))
+        pop = InferenceEngine(
+            art_dir, buckets=(4,), packed=True, packed_impl="popcount"
+        )
+        x = np.random.default_rng(13).normal(
+            size=(4, 32, 32, 3)
+        ).astype(np.float32)
+        np.testing.assert_array_equal(
+            pop.predict_logits(x), dense.predict_logits(x)
+        )
+
+    def test_bad_packed_impl_rejected(self, exported_artifact):
+        from bdbnn_tpu.serve.engine import InferenceEngine
+
+        art_dir, _ = exported_artifact
+        with pytest.raises(ValueError, match="packed_impl"):
+            InferenceEngine(art_dir, buckets=(1,), packed_impl="int8")
+
+
+# ---------------------------------------------------------------------------
+# ResidentModelCache (no JAX: stub engines)
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self, key, nbytes=100):
+        self.key = key
+        self._nbytes = nbytes
+
+    def residency(self):
+        return {
+            "resident_bytes": self._nbytes,
+            "dense_equiv_bytes": self._nbytes * 8,
+        }
+
+    def predict_logits(self, batch):
+        return np.full((len(batch), 2), hash(self.key) % 97, np.float32)
+
+
+class TestResidentModelCache:
+    def _cache(self, capacity=2, events=None):
+        from bdbnn_tpu.serve.pool import ResidentModelCache
+
+        built = []
+
+        def loader(key):
+            built.append(key)
+            return _StubEngine(key)
+
+        cache = ResidentModelCache(
+            loader,
+            capacity=capacity,
+            device="cpu:0",
+            on_event=(
+                (lambda kind, **f: events.append((kind, f)))
+                if events is not None else None
+            ),
+        )
+        return cache, built
+
+    def test_lru_eviction_order_and_accounting(self):
+        events = []
+        cache, built = self._cache(capacity=2, events=events)
+        cache.get("a")
+        cache.get("b")
+        cache.get("a")          # refreshes a: LRU order is now b, a
+        cache.get("c")          # evicts b (least recently used)
+        assert built == ["a", "b", "c"]
+        assert cache.resident_keys() == ["a", "c"]
+        s = cache.stats()
+        assert s["evictions"] == 1
+        assert s["misses"] == 3 and s["hits"] == 1
+        # byte accounting tracks what is resident NOW: the evicted
+        # model's row left with its engine
+        assert s["resident_bytes"] == {"a": 100, "c": 100}
+        assert s["dense_equiv_bytes"]["a"] == 800
+        assert "b" not in s["dense_equiv_bytes"]
+        kinds = [f.get("model") for k, f in events if k == "replica"]
+        assert "b" in kinds  # the eviction event names the victim
+        # a reload after eviction is a miss + fresh load
+        cache.get("b")
+        assert built == ["a", "b", "c", "b"]
+        assert cache.resident_keys() == ["c", "b"]
+
+    def test_capacity_one_thrashes_honestly(self):
+        cache, built = self._cache(capacity=1)
+        cache.get("a")
+        cache.get("b")
+        cache.get("a")
+        assert built == ["a", "b", "a"]
+        assert cache.stats()["evictions"] == 2
+
+    def test_capacity_validated(self):
+        from bdbnn_tpu.serve.pool import ResidentModelCache
+
+        with pytest.raises(ValueError, match="capacity"):
+            ResidentModelCache(lambda k: None, capacity=0)
+
+    def test_concurrent_gets_never_lose_accounting(self):
+        cache, _ = self._cache(capacity=4)
+        errs = []
+
+        def worker(key):
+            try:
+                for _ in range(50):
+                    assert cache.get(key).key == key
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,))
+            for k in ("a", "b", "c", "d")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        s = cache.stats()
+        assert s["hits"] + s["misses"] == 200
+        assert sorted(cache.resident_keys()) == ["a", "b", "c", "d"]
+
+    def test_resident_block_aggregation(self):
+        from bdbnn_tpu.serve.pool import resident_block
+
+        c1, _ = self._cache(capacity=2)
+        c2, _ = self._cache(capacity=2)
+        c1.get("default")
+        c2.get("default")
+        c1.get("v0002")
+        block = resident_block(
+            [c1, c2], completed_by_model={"default": 7, "v0002": 3}
+        )
+        assert block["replicas"] == 2
+        assert block["models"]["default"]["completed"] == 7
+        assert block["models"]["v0002"]["resident_bytes"] == 100
+        assert block["models"]["default"]["dense_equiv_bytes"] == 800
+        assert block["bytes_per_model_max"] == 100
+        assert resident_block([]) is None
+
+
+class TestRunnerFactoryMultiModel:
+    def test_runner_groups_by_model_and_preserves_order(
+        self, exported_artifact, tmp_path
+    ):
+        """The pooled runner contract for x-model routing: a mixed
+        coalesced batch is answered per co-resident model and
+        reassembled in arrival order — bitwise what each engine
+        answers alone."""
+        import shutil
+
+        from bdbnn_tpu.serve.engine import InferenceEngine
+        from bdbnn_tpu.serve.pool import make_engine_runner_factory
+
+        art_dir, _ = exported_artifact
+        art2 = str(tmp_path / "art2")
+        shutil.copytree(art_dir, art2)
+        factory = make_engine_runner_factory(
+            (4,),
+            packed=True,
+            resident_models=2,
+            model_dirs={"v0002": art2},
+        )
+        runner = factory(art_dir, None)
+        assert len(factory.caches) == 1
+        rng = np.random.default_rng(5)
+        imgs = [
+            rng.normal(size=(32, 32, 3)).astype(np.float32)
+            for _ in range(5)
+        ]
+        keys = [None, "v0002", None, "v0002", None]
+        results = runner(list(zip(keys, imgs)))
+        ref = InferenceEngine(art_dir, buckets=(4,), packed=True)
+        for i, img in enumerate(imgs):
+            np.testing.assert_array_equal(
+                results[i], ref.predict_logits(img[None])[0]
+            )
+        s = factory.caches[0].stats()
+        assert sorted(s["resident"]) == ["default", "v0002"]
+
+    def test_unknown_model_key_raises(self, exported_artifact):
+        from bdbnn_tpu.serve.pool import make_engine_runner_factory
+
+        art_dir, _ = exported_artifact
+        factory = make_engine_runner_factory(
+            (4,), packed=True, resident_models=2, model_dirs={}
+        )
+        runner = factory(art_dir, None)
+        img = np.zeros((32, 32, 3), np.float32)
+        with pytest.raises(KeyError, match="nope"):
+            runner([("nope", img)])
+
+    def test_swap_replaces_not_accumulates_device_cache(
+        self, monkeypatch
+    ):
+        """A blue/green swap calls the factory again per device; the
+        retired runner's cache must LEAVE factory.caches with it.
+        Accumulating would pin the old version's engines (device
+        weights never freed) and aggregate dead caches' bytes/counters
+        into the verdict's resident block."""
+        import bdbnn_tpu.serve.engine as engine_mod
+        from bdbnn_tpu.serve.pool import (
+            make_engine_runner_factory,
+            resident_block,
+        )
+
+        class _FakeEngine:
+            def __init__(self, path, **kw):
+                self.compile_seconds = {}
+
+            def residency(self):
+                return {
+                    "resident_bytes": 100,
+                    "dense_equiv_bytes": 700,
+                }
+
+        monkeypatch.setattr(engine_mod, "InferenceEngine", _FakeEngine)
+        factory = make_engine_runner_factory(
+            (4,), packed=True, resident_models=2, model_dirs={}
+        )
+        # pool construction: one runner per device
+        factory("artA", "dev0")
+        factory("artA", "dev1")
+        assert len(factory.caches) == 2
+        # swap: the factory is re-invoked for the same devices with
+        # the new artifact — per-device replacement, no accumulation
+        factory("artB", "dev0")
+        factory("artB", "dev1")
+        assert len(factory.caches) == 2
+        assert sorted(c.device for c in factory.caches) == [
+            "dev0", "dev1",
+        ]
+        block = resident_block(factory.caches)
+        assert block["replicas"] == 2
+        # only the LIVE caches' counters ride into the verdict: each
+        # post-swap cache has loaded exactly its own default engine
+        assert block["loads"] == 2
+        assert block["models"]["default"]["resident_bytes"] == 100
+
+
+# ---------------------------------------------------------------------------
+# serve-bench packed-vs-dense A/B (the memory-squeeze verdict)
+# ---------------------------------------------------------------------------
+
+
+class TestServeBenchPackedAB:
+    def test_ab_verdict_memory_events_and_compare_metrics(
+        self, exported_artifact, tmp_path
+    ):
+        """THE A/B acceptance: one serve-bench run drives the SAME load
+        dense-then-packed; the verdict's `packed` block records a >= 4x
+        resident squeeze and a measured step time on BOTH sides, the
+        run dir carries before/after `memory` events, and the compare
+        flattener exposes the new metric keys."""
+        from bdbnn_tpu.configs.config import ServeBenchConfig
+        from bdbnn_tpu.obs.compare import _serve_metrics
+        from bdbnn_tpu.obs.events import read_events
+        from bdbnn_tpu.serve.loadgen import run_serve_bench
+
+        art_dir, _ = exported_artifact
+        cfg = ServeBenchConfig(
+            artifact=art_dir,
+            log_path=str(tmp_path / "log"),
+            mode="open",
+            rate=400.0,
+            requests=40,
+            buckets=(4,),
+            queue_depth=64,
+            seed=0,
+            packed_weights="ab",
+        )
+        result = run_serve_bench(cfg)
+        v = result["verdict"]
+        assert v["requests_failed"] == 0
+        pb = v["packed"]
+        assert pb["mode"] == "ab" and pb["impl"] == "unpack"
+        assert pb["dense"]["step_ms"] > 0
+        assert pb["packed"]["step_ms"] > 0
+        assert pb["step_ms_delta_pct"] is not None
+        assert (
+            pb["packed"]["resident_bytes"]
+            < pb["dense"]["resident_bytes"]
+        )
+        assert pb["resident_ratio"] >= 4.0
+        # primary aggregates come from the packed pass; its resident
+        # footprint is the per-model figure
+        res = v["resident"]
+        assert (
+            res["bytes_per_model_max"] == pb["packed"]["resident_bytes"]
+        )
+        assert res["models"]["default"]["completed"] == 40
+        # before/after memory events on one timeline
+        mems = [
+            e for e in read_events(result["run_dir"], "memory")
+            if e.get("phase") == "serve_resident"
+        ]
+        assert [m["weights_mode"] for m in mems] == ["dense", "packed"]
+        assert (
+            mems[0]["resident_bytes"] > mems[1]["resident_bytes"]
+        )
+        assert mems[1]["ratio"] >= 4.0
+        # the compare flattener reads both new metrics off the verdict
+        flat = _serve_metrics(v)
+        assert (
+            flat["serve_resident_bytes_per_model"]
+            == pb["packed"]["resident_bytes"]
+        )
+        assert flat["serve_packed_step_ms"] == pb["packed"]["step_ms"]
+
+    def test_ab_rejects_pooled_and_paced(self, tmp_path):
+        from bdbnn_tpu.configs.config import ServeBenchConfig
+
+        with pytest.raises(ValueError, match="single-engine"):
+            ServeBenchConfig(
+                artifact="a", packed_weights="ab", replicas=(1, 2)
+            ).validate()
+        with pytest.raises(ValueError, match="single-engine"):
+            ServeBenchConfig(
+                artifact="a", packed_weights="ab", pace_ms=5.0
+            ).validate()
+
+
+# ---------------------------------------------------------------------------
+# compare judges the packed metrics; older verdicts skip cleanly
+# ---------------------------------------------------------------------------
+
+
+def _packed_verdict_file(
+    path, *, resident_bytes=None, packed_step_ms=None, schema=3
+):
+    """A minimal serve verdict artifact with (or without) the packed
+    blocks, recipe-aligned so compare judges it."""
+    v = {
+        "serve_verdict": schema,
+        "mode": "open",
+        "p99_ms": 10.0,
+        "throughput_rps": 100.0,
+        "shed_rate": 0.0,
+        "provenance": {
+            "recipe": {"arch": "resnet8_tiny", "dataset": "cifar10"},
+            "config_hash": None,
+        },
+    }
+    if resident_bytes is not None:
+        v["resident"] = {
+            "capacity": 1,
+            "replicas": 1,
+            "models": {
+                "default": {
+                    "resident_bytes": resident_bytes, "completed": 10,
+                }
+            },
+            "bytes_per_model_max": resident_bytes,
+        }
+    if packed_step_ms is not None:
+        v["packed"] = {
+            "mode": "on",
+            "impl": "unpack",
+            "dense": {"resident_bytes": resident_bytes, "step_ms": None},
+            "packed": {
+                "resident_bytes": resident_bytes,
+                "step_ms": packed_step_ms,
+            },
+            "resident_ratio": 7.0,
+            "step_ms_delta_pct": 1.0,
+        }
+    with open(path, "w") as f:
+        json.dump(v, f)
+    return str(path)
+
+
+class TestComparePackedMetrics:
+    def test_resident_bytes_regression_caught(self, tmp_path):
+        """A change that silently re-densifies the resident set (bytes
+        per model up >tol) is a regression even when latency holds."""
+        from bdbnn_tpu.obs.compare import compare_runs
+
+        base = _packed_verdict_file(
+            tmp_path / "base.json",
+            resident_bytes=100_000, packed_step_ms=5.0,
+        )
+        cand = _packed_verdict_file(
+            tmp_path / "cand.json",
+            resident_bytes=700_000, packed_step_ms=5.0,
+        )
+        result = compare_runs([base, cand])
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert (
+            rows["serve_resident_bytes_per_model"]["verdict"]
+            == "regression"
+        )
+        assert result["verdict"] == "regression"
+
+    def test_packed_step_ms_regression_caught(self, tmp_path):
+        from bdbnn_tpu.obs.compare import compare_runs
+
+        base = _packed_verdict_file(
+            tmp_path / "base.json",
+            resident_bytes=100_000, packed_step_ms=5.0,
+        )
+        cand = _packed_verdict_file(
+            tmp_path / "cand.json",
+            resident_bytes=100_000, packed_step_ms=9.0,
+        )
+        result = compare_runs([base, cand])
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert rows["serve_packed_step_ms"]["verdict"] == "regression"
+
+    def test_verdicts_without_packed_blocks_skip_cleanly(self, tmp_path):
+        """v1/v2/v3-without-packed verdicts carry no resident/packed
+        blocks: the new metrics must be ABSENT from the judged rows
+        (skipped), never a crash or a phantom regression — pinned for
+        old-vs-old and old-vs-new alike."""
+        from bdbnn_tpu.obs.compare import compare_runs, extract_run
+
+        old_a = _packed_verdict_file(tmp_path / "a.json", schema=1)
+        old_b = _packed_verdict_file(tmp_path / "b.json", schema=2)
+        new = _packed_verdict_file(
+            tmp_path / "new.json",
+            resident_bytes=100_000, packed_step_ms=5.0,
+        )
+        ex = extract_run(old_a)
+        assert ex["metrics"]["serve_resident_bytes_per_model"] is None
+        assert ex["metrics"]["serve_packed_step_ms"] is None
+        for pair in ([old_a, old_b], [old_a, new]):
+            result = compare_runs(pair)
+            judged = {
+                m["metric"]
+                for m in result["comparisons"][0]["metrics"]
+            }
+            assert "serve_resident_bytes_per_model" not in judged
+            assert "serve_packed_step_ms" not in judged
+            # the aggregates still compared — skipping must not mean
+            # "compared nothing"
+            assert result["comparisons"][0]["verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e: two co-resident packed models behind a 2-replica
+# serve-http, routed by x-model over real sockets, zero dropped
+# ---------------------------------------------------------------------------
+
+
+class TestServeHttpCoResidentModels:
+    def test_two_models_routed_by_x_model_zero_dropped(
+        self, exported_artifact, tmp_path
+    ):
+        from bdbnn_tpu.configs.config import ServeHttpConfig
+        from bdbnn_tpu.serve.http import run_serve_http
+        from bdbnn_tpu.serve.registry import ArtifactRegistry
+
+        art_dir, _ = exported_artifact
+        reg_root = str(tmp_path / "registry")
+        reg = ArtifactRegistry(reg_root)
+        assert reg.publish(art_dir)["version"] == 1
+        assert reg.publish(art_dir)["version"] == 2
+
+        cfg = ServeHttpConfig(
+            artifact="v0001",
+            registry=reg_root,
+            log_path=str(tmp_path / "log"),
+            replicas=2,
+            resident_models=2,
+            packed_weights=True,
+            scenario="poisson",
+            rate=300.0,
+            requests=40,
+            concurrency=8,
+            buckets=(4,),
+            queue_depth=64,
+            models=("v0001", "v0002"),
+            seed=3,
+        )
+        result = run_serve_http(cfg)
+        v = result["verdict"]
+        # the drain contract's cross-check: every request got SOME
+        # response — zero dropped connections
+        assert v["client"]["dropped"] == 0
+        assert v["requests_failed"] == 0
+        assert v["requests_completed"] == 40
+        # both models served, co-resident (v0001 IS the default —
+        # routed without a second copy; v0002 is the second resident)
+        res = v["resident"]
+        assert res["models"]["default"]["completed"] > 0
+        assert res["models"]["v0002"]["completed"] > 0
+        assert (
+            res["models"]["default"]["completed"]
+            + res["models"]["v0002"]["completed"]
+            == v["requests_completed"]
+        )
+        # packed residency held end to end: >= 4x squeeze per model
+        assert v["packed"]["resident_ratio"] >= 4.0
+        # no model was ever evicted/reloaded mid-run: both stayed
+        # resident on every replica (the whole point of the cache)
+        assert res["evictions"] == 0
+        assert res["replicas"] == 2
+
+    def test_x_model_rejected_without_multi_model(
+        self, http_frontend
+    ):
+        """A server not configured for multi-model must 404 an x-model
+        request (ledgered as rejected), never silently answer from the
+        wrong model."""
+        import socket
+
+        fe = http_frontend()
+        sock = socket.create_connection((fe.host, fe.port), timeout=5)
+        body = b"[1, 2]"
+        sock.sendall(
+            b"POST /v1/predict HTTP/1.1\r\n"
+            b"host: x\r\nx-model: v0002\r\n"
+            b"content-type: application/json\r\n"
+            + f"content-length: {len(body)}\r\n\r\n".encode() + body
+        )
+        resp = sock.recv(4096).decode()
+        sock.close()
+        assert resp.startswith("HTTP/1.1 404")
+        assert "multi-model routing disabled" in resp
+        counts = fe.accounting()["counts_by_priority"]
+        assert sum(c["rejected"] for c in counts) == 1

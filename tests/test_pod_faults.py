@@ -135,9 +135,12 @@ class TestCoordinatedPreemption:
     one aligned coordinated checkpoint; resume onto a smaller topology
     reproduces the uninterrupted run."""
 
-    @pytest.fixture(scope="class")
-    def pod_victim(self, tmp_path_factory):
-        root = tmp_path_factory.mktemp("pod_sigterm")
+    def _spawn_victim_attempt(self, root):
+        """One attempt: bring the 2-process pod up to a running train
+        step, SIGTERM host 0, reap. Raises AssertionError when the
+        cluster never FORMED (a worker dying during GRPC coordinator
+        bring-up) so the fixture can bound a retry; contract
+        violations by a formed cluster are judged by the tests."""
         # --save-every-mins at a tiny interval: every boundary's
         # coordination carries process-0's (always-due) clock decision,
         # exercising the previously banned wallclock path on a pod.
@@ -164,6 +167,31 @@ class TestCoordinatedPreemption:
                 if p.poll() is None:
                     p.kill()
         return {"run_dir": run_dir, "outs": outs}
+
+    @pytest.fixture(scope="class")
+    def pod_victim(self, tmp_path_factory):
+        """Bounded retry-once around cluster formation — the same
+        policy as tests/test_multihost.py, for the same documented
+        transient (PR 7/8/9 notes: a worker dying or timing out during
+        GRPC coordinator bring-up on a contended box; in-suite ERRORs
+        that never reproduce in isolation). Only the did-the-cluster-
+        form assertion retries; every post-formation contract is
+        asserted by the tests and fails deterministically."""
+        import warnings
+
+        try:
+            return self._spawn_victim_attempt(
+                tmp_path_factory.mktemp("pod_sigterm")
+            )
+        except AssertionError as first:
+            warnings.warn(
+                "pod cluster attempt 1 never formed (known transient "
+                "on contended boxes, PR 7/8/9 notes) — retrying once: "
+                f"{first}"
+            )
+            return self._spawn_victim_attempt(
+                tmp_path_factory.mktemp("pod_sigterm_retry")
+            )
 
     def test_every_host_exits_75(self, pod_victim):
         rcs = [rc for rc, _, _ in pod_victim["outs"]]

@@ -29,6 +29,7 @@ import json
 import os
 import threading
 import time
+import warnings
 
 import numpy as np
 import pytest
@@ -1072,13 +1073,34 @@ class TestScalingSweep:
     ):
         """serve-bench --replicas 1 2 4 8 (in-process, paced): monotone
         throughput, efficiency >= 0.7 at 8 replicas, verdict + events
-        + summarize/watch/compare all consume the v3 shape."""
+        + summarize/watch/compare all consume the v3 shape.
+
+        Bounded retry-once, same policy as tests/test_multihost.py.
+        TRACKING NOTE: PR 9 recorded ONE in-suite transient (efficiency
+        0.55 during a full tier-1 pass on a contended box; passes in
+        isolation and on rerun) — the paced operating point measures
+        wall-clock parallelism, which a loaded host cannot always
+        deliver. A deterministic regression (broken dispatch, verdict
+        schema, event shapes) fails BOTH attempts; the first failure
+        surfaces as a warning so a recurring flake stays visible."""
+        try:
+            self._paced_sweep_attempt(exported_artifact, tmp_path / "a1")
+        except AssertionError as first:
+            warnings.warn(
+                "paced scaling sweep attempt 1 failed (known "
+                "timing-sensitive transient on contended boxes, PR 9 "
+                f"note) — retrying once: {first}"
+            )
+            self._paced_sweep_attempt(exported_artifact, tmp_path / "a2")
+
+    def _paced_sweep_attempt(self, exported_artifact, tmp_path):
         from bdbnn_tpu.configs.config import ServeBenchConfig
         from bdbnn_tpu.obs.compare import compare_runs
         from bdbnn_tpu.obs.events import read_events
         from bdbnn_tpu.obs.summarize import summarize_run
         from bdbnn_tpu.serve.loadgen import run_serve_bench
 
+        tmp_path.mkdir(parents=True, exist_ok=True)
         art_dir, _ = exported_artifact
         # operating point tuned for a GIL-shared host: service time
         # (40ms/batch) well above the serial batch-assembly cost, and

@@ -583,6 +583,40 @@ class TestEngineFidelity:
         np.testing.assert_allclose(chunked, singles, atol=1e-5)
         assert sorted(engine.compile_seconds) == [1, 4]
 
+    def test_chunk_boundary_logit_equality(self, exported_artifact):
+        """THE oversize-chunk seam pin: the single-loop dispatch (no
+        recursive re-entry for the final short chunk) yields logits
+        BITWISE equal to per-row prediction at exactly the boundary
+        sizes — n = big+1 (one full chunk + a pad-to-1 tail) and
+        n = 2*big+3 (two full chunks + a padded tail) — so the packed
+        path inherits a clean seam."""
+        from bdbnn_tpu.serve.engine import InferenceEngine
+
+        art_dir, _ = exported_artifact
+        engine = InferenceEngine(art_dir, buckets=(1, 4))
+        big = engine.buckets[-1]
+        rng = np.random.default_rng(7)
+        for n in (big + 1, 2 * big + 3):
+            x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+            got = engine.predict_logits(x)
+            assert got.shape == (n, 10)
+            # bitwise vs standalone big-sized slices: the loop's chunk
+            # boundaries land at multiples of `big`, and the final
+            # short chunk pads exactly like a standalone short batch —
+            # no re-entry, no double padding, no seam drift
+            by_slice = np.concatenate([
+                engine.predict_logits(x[i : i + big])
+                for i in range(0, n, big)
+            ])
+            np.testing.assert_array_equal(got, by_slice)
+            # and numerically vs per-row prediction (bucket-1 vs
+            # bucket-4 executables may round differently in the last
+            # ulp — same tolerance as the padding test above)
+            rows = np.concatenate(
+                [engine.predict_logits(x[i : i + 1]) for i in range(n)]
+            )
+            np.testing.assert_allclose(got, rows, atol=1e-5)
+
 
 # ---------------------------------------------------------------------------
 # serve-bench end-to-end
