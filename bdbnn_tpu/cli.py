@@ -713,6 +713,22 @@ def serve_bench_main(argv) -> int:
         help="packed reconstruction: unpackbits->conv (default) or the "
         "XNOR-popcount dot for wide layers (f32 artifacts only)",
     )
+    ap.add_argument(
+        "--no-rtrace", dest="rtrace", action="store_false",
+        help="disable request-path tracing (obs/rtrace.py): the v4 "
+        "verdict's attribution block lands null",
+    )
+    ap.add_argument(
+        "--rtrace-sample-every", type=int, default=16,
+        help="emit every Nth request's full waterfall as an rtrace "
+        "event (deterministic seeded sampling; the slowest-K tail is "
+        "kept regardless; default 16)",
+    )
+    ap.add_argument(
+        "--rtrace-tail-k", type=int, default=5,
+        help="slowest requests per priority kept as tail exemplars in "
+        "the verdict's attribution block (default 5)",
+    )
     args = ap.parse_args(argv)
 
     _force_jax_platforms()
@@ -738,6 +754,9 @@ def serve_bench_main(argv) -> int:
         wedge_timeout_s=args.wedge_timeout_s,
         packed_weights=args.packed_weights,
         packed_impl=args.packed_impl,
+        rtrace=args.rtrace,
+        rtrace_sample_every=args.rtrace_sample_every,
+        rtrace_tail_k=args.rtrace_tail_k,
     )
     result = run_serve_bench(cfg)
     print(json.dumps(result["verdict"], indent=2, sort_keys=True))
@@ -904,6 +923,22 @@ def serve_http_main(argv) -> int:
         "--model-weights", type=float, nargs="+", default=[],
         help="request mix per --models entry (default uniform)",
     )
+    ap.add_argument(
+        "--no-rtrace", dest="rtrace", action="store_false",
+        help="disable request-path tracing (obs/rtrace.py): no stage "
+        "histograms on /statsz, attribution lands null in the verdict",
+    )
+    ap.add_argument(
+        "--rtrace-sample-every", type=int, default=16,
+        help="emit every Nth request's full waterfall as an rtrace "
+        "event (deterministic seeded sampling; the slowest-K tail is "
+        "kept regardless; default 16)",
+    )
+    ap.add_argument(
+        "--rtrace-tail-k", type=int, default=5,
+        help="slowest requests per priority kept as tail exemplars in "
+        "the verdict's attribution block (default 5)",
+    )
     args = ap.parse_args(argv)
 
     _force_jax_platforms()
@@ -947,6 +982,9 @@ def serve_http_main(argv) -> int:
         resident_models=args.resident_models,
         models=tuple(args.models),
         model_weights=tuple(args.model_weights),
+        rtrace=args.rtrace,
+        rtrace_sample_every=args.rtrace_sample_every,
+        rtrace_tail_k=args.rtrace_tail_k,
     )
     result = run_serve_http(cfg)
     print(json.dumps(result["verdict"], indent=2, sort_keys=True))

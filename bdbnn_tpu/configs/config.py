@@ -294,6 +294,16 @@ class ServeBenchConfig:
     # -> stock XLA conv, the default) or "popcount" (XNOR-popcount dot
     # on uint32 lanes — the wide-layer option; f32 artifacts only)
     packed_impl: str = "unpack"
+    # request-path tracing (obs/rtrace.py): per-request lifecycle
+    # spans (queue/coalesce/dispatch/compute) rolled into the v4
+    # verdict's attribution block. sample_every picks which FULL
+    # waterfalls are emitted as rtrace events (deterministic seeded
+    # sampling; 1 = every request); the slowest rtrace_tail_k
+    # requests per priority are kept regardless. rtrace=False turns
+    # the recorder off entirely (attribution lands null).
+    rtrace: bool = True
+    rtrace_sample_every: int = 16
+    rtrace_tail_k: int = 5
 
     def validate(self) -> "ServeBenchConfig":
         if not self.artifact:
@@ -344,6 +354,13 @@ class ServeBenchConfig:
                 "> 1 or --pace-ms — a pooled/paced A/B would conflate "
                 "dispatch effects with residency effects"
             )
+        if self.rtrace_sample_every < 1:
+            raise ValueError(
+                "--rtrace-sample-every must be >= 1 (1 = every "
+                "request; use --no-rtrace to disable tracing)"
+            )
+        if self.rtrace_tail_k < 0:
+            raise ValueError("--rtrace-tail-k must be >= 0")
         return self
 
 
@@ -433,6 +450,14 @@ class ServeHttpConfig:
     # the default model
     models: Tuple[str, ...] = ()
     model_weights: Tuple[float, ...] = ()
+    # request-path tracing (obs/rtrace.py): socket-to-socket lifecycle
+    # spans (read/admit/queue/coalesce/dispatch/compute/respond) in
+    # the v4 verdict's attribution block, live stage histograms on
+    # /statsz and the rtrace event heartbeat `watch` renders. Same
+    # knob semantics as ServeBenchConfig.
+    rtrace: bool = True
+    rtrace_sample_every: int = 16
+    rtrace_tail_k: int = 5
 
     @property
     def pooled(self) -> bool:
@@ -628,4 +653,11 @@ class ServeHttpConfig:
                 "--model-weights needs one nonnegative weight per "
                 f"model ({len(self.models)}), summing > 0"
             )
+        if self.rtrace_sample_every < 1:
+            raise ValueError(
+                "--rtrace-sample-every must be >= 1 (1 = every "
+                "request; use --no-rtrace to disable tracing)"
+            )
+        if self.rtrace_tail_k < 0:
+            raise ValueError("--rtrace-tail-k must be >= 0")
         return self

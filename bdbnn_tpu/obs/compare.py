@@ -103,6 +103,17 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     # v3-without-packed verdicts leave both None (skipped).
     ("serve_resident_bytes_per_model", "lower", "rel"),
     ("serve_packed_step_ms", "lower", "rel"),
+    # v4 request-path attribution (obs/rtrace.py): the stage-share
+    # regression gates. serve_p99_queue_ms / serve_p99_compute_ms are
+    # the queue-wait and device-compute stage p99s (rolling windows,
+    # merged across priorities); serve_queue_share is the
+    # (queue + dispatch) share of the summed stage means. A p99 that
+    # moved from device-bound to queue-bound regresses here — exit 3 —
+    # even when the aggregate serve_p99_ms is flat. v1-v3 verdicts
+    # (no attribution block) leave all three None (skipped).
+    ("serve_p99_queue_ms", "lower", "rel"),
+    ("serve_p99_compute_ms", "lower", "rel"),
+    ("serve_queue_share", "lower", "rel"),
 )
 
 # serve-verdict field -> compare metric name (flat v1 aggregates)
@@ -153,6 +164,18 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
     out["serve_packed_step_ms"] = (
         ((packed or {}).get("packed") or {}).get("step_ms")
     )
+    # v4 attribution block (obs/rtrace.py): the stage decomposition's
+    # queue/compute p99s + the queue share — None on v1-v3 verdicts
+    # and traced-off v4 runs, so they skip cleanly
+    att = verdict.get("attribution")
+    stages = (att or {}).get("stages") or {}
+    out["serve_p99_queue_ms"] = (
+        (stages.get("queue") or {}).get("p99_ms")
+    )
+    out["serve_p99_compute_ms"] = (
+        (stages.get("compute") or {}).get("p99_ms")
+    )
+    out["serve_queue_share"] = (att or {}).get("queue_share")
     swap = verdict.get("swap")
     if swap is None:
         out["serve_swap_dropped"] = None

@@ -115,6 +115,17 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
   drained its vN work and now serves vN+1), ``done`` (rollout
   complete: seconds, replicas shifted), ``failed`` (the standby build
   aborted — vN kept serving; error recorded)
+- ``rtrace``      — request-path lifecycle tracing (obs/rtrace.py),
+  disambiguated by ``phase``: ``request`` (one SAMPLED request's full
+  waterfall — seq, priority, tenant, total_ms, per-stage ms over the
+  read/admit/queue/coalesce/dispatch/compute/respond taxonomy;
+  deterministic seeded sampling, so the same seed emits the same
+  exemplars) and ``stats`` (the periodic heartbeat: per-stage p99
+  over the rolling windows, end-to-end p99 per priority, the
+  queue-share figure — what ``watch`` renders as the live waterfall
+  and ``/statsz`` mirrors). The final per-priority decomposition,
+  reconciliation identity and tail-exemplar table land in the v4 SLO
+  verdict's ``attribution`` block, not in events
 
 New kinds must be registered in :data:`KNOWN_KINDS` —
 ``tests/test_events_schema.py`` AST-scans every ``.emit(`` call site in
@@ -168,6 +179,7 @@ KNOWN_KINDS = frozenset(
         "admission",
         "replica",
         "swap",
+        "rtrace",
     }
 )
 
@@ -336,7 +348,15 @@ def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     admissions = [e for e in events if e.get("kind") == "admission"]
     replicas = [e for e in events if e.get("kind") == "replica"]
     swaps = [e for e in events if e.get("kind") == "swap"]
+    rtraces = [e for e in events if e.get("kind") == "rtrace"]
     return {
+        "rtrace_stats": next(
+            (
+                e for e in reversed(rtraces)
+                if e.get("phase") == "stats"
+            ),
+            None,
+        ),
         "replica_stats": next(
             (
                 e for e in reversed(replicas)

@@ -343,7 +343,7 @@ def _serving(events) -> Optional[Dict[str, Any]]:
                           "max_queue", "preempted", "drained_clean",
                           "wall_s", "scenario", "per_priority",
                           "per_tenant", "fairness_ratio", "slo",
-                          "replicas", "scaling", "swap")
+                          "replicas", "scaling", "swap", "attribution")
             }
             if verdict
             else None
@@ -684,6 +684,68 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                             f"{v}: {n}" for v, n in sorted(by.items())
                         )
                     )
+            # the v4 request-path attribution: per-priority p99
+            # decomposed by lifecycle stage, the reconciliation
+            # identity, and the slowest exemplars' waterfalls
+            att = sv.get("attribution")
+            if att:
+                recon = att.get("reconciliation") or {}
+                share = att.get("queue_share")
+                lines.append(
+                    f"  trace: {att.get('requests')} requests traced "
+                    f"(sampled {att.get('sampled')}, 1/"
+                    f"{att.get('sample_every')})"
+                    + (
+                        f" | queue share {share:.0%}"
+                        if share is not None else ""
+                    )
+                    + (
+                        f" | stage sum vs e2e: mean err "
+                        f"{recon.get('mean_abs_err_pct')}% "
+                        + ("OK" if recon.get("ok") else "BROKEN")
+                        if recon.get("mean_abs_err_pct") is not None
+                        else ""
+                    )
+                )
+                stage_names = list((att.get("stages") or {}).keys())
+                per_priority_att = att.get("per_priority") or {}
+                if per_priority_att and stage_names:
+                    lines.append(
+                        "  "
+                        + f"{'class':<9}"
+                        + "".join(f"{s:>10}" for s in stage_names)
+                        + f"{'e2e':>10}"
+                    )
+
+                    def _a(block):
+                        if not block or block.get("p99_ms") is None:
+                            return "-"
+                        return f"{block['p99_ms']:.1f}"
+
+                    for p in sorted(per_priority_att, key=int):
+                        v = per_priority_att[p]
+                        stages_p = v.get("stages") or {}
+                        lines.append(
+                            "  "
+                            + f"p99 p{p:<4}"
+                            + "".join(
+                                f"{_a(stages_p.get(s)):>10}"
+                                for s in stage_names
+                            )
+                            + f"{_a(v.get('e2e')):>10}"
+                        )
+                for p, wfs in sorted((att.get("tail") or {}).items()):
+                    for wf in wfs[:1]:
+                        waterfall = " + ".join(
+                            f"{stage} {ms:.1f}"
+                            for stage, ms in (
+                                wf.get("stages") or {}
+                            ).items()
+                        )
+                        lines.append(
+                            f"    slowest p{p}: #{wf.get('seq')} "
+                            f"{wf.get('total_ms')}ms = {waterfall}"
+                        )
     if tta:
         lines.append("time-to-accuracy (val top-1):")
         for r in tta:

@@ -187,6 +187,24 @@ def _serve_lines(events) -> List[str]:
             f"shed {s.get('shed')} | {s.get('completed')} done | "
             f"{age:.0f}s ago"
         )
+    rtrace = digest["rtrace_stats"]
+    if rtrace and verdict is None:
+        # the live waterfall: per-stage p99 over the rolling windows —
+        # queue-bound vs device-bound, WHILE it happens
+        stage_p99 = rtrace.get("stage_p99_ms") or {}
+        parts = [
+            f"{stage} {ms:.1f}"
+            for stage, ms in stage_p99.items()
+            if ms is not None
+        ]
+        share = rtrace.get("queue_share")
+        lines.append(
+            "trace: p99/stage ms  " + " > ".join(parts)
+            + (
+                f" | queue share {share:.0%}"
+                if share is not None else ""
+            )
+        )
     if verdict:
         shed_rate = float(verdict.get("shed_rate") or 0.0)
         lines.append(
@@ -245,6 +263,40 @@ def _serve_lines(events) -> List[str]:
                     )
                 )
             )
+        att = verdict.get("attribution")
+        if att:
+            # the final waterfall: where the p99 went, stage by stage,
+            # plus the slowest request's full decomposition
+            stage_parts = [
+                f"{stage} {b['p99_ms']:.1f}"
+                for stage, b in (att.get("stages") or {}).items()
+                if b is not None and b.get("p99_ms") is not None
+            ]
+            share = att.get("queue_share")
+            recon = att.get("reconciliation") or {}
+            lines.append(
+                "  trace: p99/stage ms  " + " > ".join(stage_parts)
+                + (
+                    f" | queue share {share:.0%}"
+                    if share is not None else ""
+                )
+                + (
+                    "" if recon.get("ok") in (True, None)
+                    else " | RECONCILIATION BROKEN"
+                )
+            )
+            for p, wfs in sorted((att.get("tail") or {}).items()):
+                if not wfs:
+                    continue
+                wf = wfs[0]  # the slowest exemplar of this class
+                waterfall = " + ".join(
+                    f"{stage} {ms:.1f}"
+                    for stage, ms in (wf.get("stages") or {}).items()
+                )
+                lines.append(
+                    f"    slowest p{p}: #{wf.get('seq')} "
+                    f"{wf.get('total_ms')}ms = {waterfall}"
+                )
     return lines
 
 

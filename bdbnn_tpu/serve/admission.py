@@ -156,24 +156,33 @@ class AdmissionController:
 
     # -- request path --------------------------------------------------
 
-    def admit(self, tenant: str) -> str:
+    def admit(self, tenant: str, trace=None) -> str:
         """One decision per request: ``draining`` | ``over_quota`` |
         ``admit`` (in that precedence — a draining server must not
-        charge tenants tokens for requests it will not serve)."""
+        charge tenants tokens for requests it will not serve).
+        ``trace`` (optional, obs/rtrace.py) gets its ``admit`` span
+        stamped here — the quota decision's cost belongs to the layer
+        that owns it, the same owning-site rule as the training side's
+        ``jax.named_scope`` spans."""
         with self._lock:
             if self._draining.is_set():
-                return DRAINING
-            bucket = self._buckets.get(tenant)
-            if bucket is None:
-                rate, burst = self.quota_for(tenant)
-                bucket = TokenBucket(rate, burst, clock=self._clock)
-                self._buckets[tenant] = bucket
-            counts = self._tenant_counts(tenant)
-            if not bucket.try_take():
-                counts["over_quota"] += 1
-                return OVER_QUOTA
-            counts["admitted"] += 1
-            return ADMIT
+                decision = DRAINING
+            else:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    rate, burst = self.quota_for(tenant)
+                    bucket = TokenBucket(rate, burst, clock=self._clock)
+                    self._buckets[tenant] = bucket
+                counts = self._tenant_counts(tenant)
+                if not bucket.try_take():
+                    counts["over_quota"] += 1
+                    decision = OVER_QUOTA
+                else:
+                    counts["admitted"] += 1
+                    decision = ADMIT
+        if trace is not None:
+            trace.stamp("admit")
+        return decision
 
     def record_shed(self, tenant: str) -> None:
         """An ADMITTED request the batcher then shed (queue full or a

@@ -39,6 +39,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
 
+from bdbnn_tpu.obs.rtrace import pop_future_timing
+
 
 class LoadShedError(RuntimeError):
     """The request was rejected — queue full or batcher draining."""
@@ -49,13 +51,17 @@ class LoadShedError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("payload", "priority", "future", "t_enqueue")
+    __slots__ = ("payload", "priority", "future", "t_enqueue", "trace")
 
-    def __init__(self, payload, priority: int = 0):
+    def __init__(self, payload, priority: int = 0, trace=None):
         self.payload = payload
         self.priority = priority
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
+        # optional obs.rtrace.RequestTrace riding the request: the
+        # batcher stamps its queue/coalesce/dispatch/compute stages at
+        # the owning sites; None costs one attribute read per boundary
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -148,13 +154,15 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, payload, priority: int = 0) -> Future:
+    def submit(self, payload, priority: int = 0, trace=None) -> Future:
         """Enqueue one request into its priority class; returns its
         Future. Raises :class:`LoadShedError` when draining or that
         class's queue is full — never blocks the caller on a full
         queue; raises ``ValueError`` on an out-of-range priority (a
         malformed header must be rejected by the CALLER with a 400,
-        not silently reclassified here).
+        not silently reclassified here). ``trace`` (optional,
+        obs/rtrace.py) rides the request so the worker can stamp its
+        queue-wait and coalesce spans at the sites that own them.
 
         The enqueue happens under ``_lock``, the same lock the worker's
         drain-exit holds for its final queue sweep + ``_dead`` latch: a
@@ -166,7 +174,7 @@ class MicroBatcher:
             raise ValueError(
                 f"priority must be in [0, {self.priorities}), got {p}"
             )
-        req = _Request(payload, p)
+        req = _Request(payload, p, trace=trace)
         with self._cv:
             if self._dead or self._draining.is_set():
                 self.shed += 1
@@ -276,6 +284,11 @@ class MicroBatcher:
                 if self._draining.is_set():
                     return []
                 self._cv.wait(timeout=0.02)
+        if first.trace is not None:
+            # queue stage ends at pickup — everything since submit
+            # (including any async-backpressure hold that kept the
+            # worker from assembling a batch) is queue wait
+            first.trace.stamp("queue")
         batch = [first]
         deadline = first.t_enqueue + self.max_delay_s
         while len(batch) < self.max_batch:
@@ -290,6 +303,8 @@ class MicroBatcher:
                     self._cv.wait(timeout=remaining)
                     nxt = self._pop_highest()
             if nxt is not None:
+                if nxt.trace is not None:
+                    nxt.trace.stamp("queue")
                 batch.append(nxt)
             elif time.monotonic() >= deadline or self._draining.is_set():
                 break
@@ -327,6 +342,10 @@ class MicroBatcher:
                         req.future.set_exception(LoadShedError("draining"))
                 return
             t0 = time.monotonic()
+            for r in batch:
+                if r.trace is not None:
+                    # coalesce stage ends when the batch dispatches
+                    r.trace.stamp("coalesce")
             try:
                 results = self.runner([r.payload for r in batch])
             except Exception as e:
@@ -352,7 +371,8 @@ class MicroBatcher:
                                     r.future.set_exception(e)
                         else:
                             self._settle(
-                                batch, f.result(), t0, time.monotonic()
+                                batch, f.result(), t0, time.monotonic(),
+                                timing=pop_future_timing(f),
                             )
                     finally:
                         with self._cv:
@@ -363,10 +383,27 @@ class MicroBatcher:
                 continue
             self._settle(batch, results, t0, time.monotonic())
 
-    def _settle(self, batch, results, t0: float, t1: float) -> None:
+    def _settle(
+        self, batch, results, t0: float, t1: float, timing=None
+    ) -> None:
         """Distribute one executed batch's results and account it —
         shared by the synchronous runner path and the async-dispatch
-        callback."""
+        callback. ``timing`` is the replica pool's measured
+        (dispatch_ms, compute_ms) split riding the batch Future
+        (obs/rtrace.py); the sync path has no dispatch hop, so the
+        whole runner wall is the compute stage."""
+        # stage accounting BEFORE the futures resolve: a waiter waking
+        # on set_result must observe a fully-stamped trace
+        for r in batch:
+            tr = r.trace
+            if tr is None:
+                continue
+            if timing is not None:
+                tr.add("dispatch", timing[0])
+                tr.add("compute", timing[1])
+                tr.sync()
+            else:
+                tr.stamp("compute")
         for i, r in enumerate(batch):
             # done() guard: a client may have cancel()ed its Future
             # (set_result would raise InvalidStateError); a runner

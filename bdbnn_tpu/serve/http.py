@@ -31,7 +31,9 @@ Endpoints:
 ``GET /statsz``       live stats JSON: per-priority queue occupancy
                       (one source of truth: ``MicroBatcher.stats()``),
                       per-tenant admission counters, in-flight count,
-                      readiness state.
+                      readiness state, and — when tracing is on — the
+                      live request-path stage histograms
+                      (obs/rtrace.py: per-stage p99, queue share).
 ==================  ====================================================
 
 **Drain contract (the PR 5 semantics extended over sockets).** SIGTERM
@@ -126,9 +128,16 @@ class HttpFrontEnd:
         retry_after_s: int = 1,
         admin: Optional[Any] = None,
         model_router: Optional[Callable[[str], str]] = None,
+        tracer: Optional[Any] = None,
     ):
         self.batcher = batcher
         self.admission = admission
+        # request-lifecycle tracer (obs/rtrace.py): when wired, every
+        # served request gets read/admit/queue/coalesce/dispatch/
+        # compute/respond spans, /statsz exposes the live stage
+        # histograms and the verdict carries the attribution block.
+        # None = zero per-request cost beyond one attribute read.
+        self.tracer = tracer
         self.ready_fn = ready_fn
         self.decode = decode
         self.encode = encode
@@ -313,6 +322,11 @@ class HttpFrontEnd:
         line = await reader.readline()
         if not line:
             return None
+        # the read-stage clock starts when the request LINE lands, not
+        # when the connection went readable: an idle keep-alive
+        # connection parked in readline must not charge its idle wait
+        # to the next request's read span
+        t_recv = time.perf_counter()
         parts = line.decode("latin-1").strip().split()
         if len(parts) != 3:
             raise ValueError(f"malformed request line: {line!r}")
@@ -326,9 +340,9 @@ class HttpFrontEnd:
             headers[name.strip().lower()] = value.strip()
         n = int(headers.get("content-length", 0) or 0)
         if n > self.max_body_bytes:
-            return method, path, headers, None  # signals 413
+            return method, path, headers, None, t_recv  # signals 413
         body = await reader.readexactly(n) if n else b""
-        return method, path, headers, body
+        return method, path, headers, body, t_recv
 
     def _respond(
         self, writer, status: int, obj: Any, *,
@@ -360,7 +374,7 @@ class HttpFrontEnd:
                     break
                 if req is None:
                     break
-                method, path, headers, body = req
+                method, path, headers, body, t_recv = req
                 close = (
                     headers.get("connection", "").lower() == "close"
                 )
@@ -370,7 +384,9 @@ class HttpFrontEnd:
                         close=True,
                     )
                     break
-                await self._route(writer, method, path, headers, body)
+                await self._route(
+                    writer, method, path, headers, body, t_recv
+                )
                 await writer.drain()
                 if close or self._draining.is_set():
                     # draining: close at the request boundary so the
@@ -389,7 +405,9 @@ class HttpFrontEnd:
                 self._conns -= 1
                 self._inflight_cv.notify_all()
 
-    async def _route(self, writer, method, path, headers, body) -> None:
+    async def _route(
+        self, writer, method, path, headers, body, t_recv=None
+    ) -> None:
         if method == "GET" and path == "/healthz":
             self._respond(writer, 200, {
                 "status": "ok",
@@ -411,7 +429,7 @@ class HttpFrontEnd:
         elif path in ("/admin/replicas", "/admin/swap"):
             await self._admin(writer, method, path, body)
         elif method == "POST" and path == PREDICT_PATH:
-            await self._predict(writer, headers, body)
+            await self._predict(writer, headers, body, t_recv)
         else:
             self._respond(
                 writer, 404, {"error": f"no route {method} {path}"}
@@ -463,7 +481,7 @@ class HttpFrontEnd:
                 writer, 404, {"error": f"no route {method} {path}"}
             )
 
-    async def _predict(self, writer, headers, body) -> None:
+    async def _predict(self, writer, headers, body, t_recv=None) -> None:
         t0 = time.perf_counter()
         if self._t_started is None:
             # the verdict's wall clock starts at the FIRST request, not
@@ -489,6 +507,16 @@ class HttpFrontEnd:
                     "got": raw_p,
                 })
                 return
+        trace = None
+        if self.tracer is not None:
+            # the span timeline starts at request receipt (the request
+            # line's arrival when known); the first stamp charges the
+            # socket read + parse that already happened
+            trace = self.tracer.begin(
+                priority, tenant,
+                t_start=t_recv if t_recv is not None else t0,
+            )
+            trace.stamp("read")
         # in-flight covers the WHOLE predict — admission through the
         # written response — so drain's inflight-zero wait cannot race
         # a request between submit and accounting
@@ -496,20 +524,29 @@ class HttpFrontEnd:
             self._inflight += 1
         try:
             await self._predict_body(
-                writer, headers, body, t0, tenant, priority
+                writer, headers, body, t0, tenant, priority, trace
             )
         finally:
             with self._inflight_cv:
                 self._inflight -= 1
                 self._inflight_cv.notify_all()
 
+    def _abort_trace(self, trace) -> None:
+        """A request that ends without a served response (shed /
+        rejected / failed) leaves the stage statistics untouched — a
+        503 written in microseconds must never read as a fast serve."""
+        if trace is not None and self.tracer is not None:
+            self.tracer.abort(trace)
+
     async def _predict_body(
-        self, writer, headers, body, t0, tenant: str, priority: int
+        self, writer, headers, body, t0, tenant: str, priority: int,
+        trace=None,
     ) -> None:
         counts = self._counts_by_priority[priority]
         counts["submitted"] += 1
-        decision = self.admission.admit(tenant)
+        decision = self.admission.admit(tenant, trace=trace)
         if decision == DRAINING:
+            self._abort_trace(trace)
             counts["shed_draining"] += 1
             self._respond(
                 writer, 503,
@@ -518,6 +555,7 @@ class HttpFrontEnd:
             )
             return
         if decision == OVER_QUOTA:
+            self._abort_trace(trace)
             counts["shed_over_quota"] += 1
             self._respond(
                 writer, 429,
@@ -532,6 +570,7 @@ class HttpFrontEnd:
             # no router configured: answering from the (only) resident
             # model while the client asked for a specific one would be
             # silently wrong — explicit 404, ledgered like a bad body
+            self._abort_trace(trace)
             counts["rejected"] += 1
             self.admission.record_rejected(tenant)
             self._respond(writer, 404, {
@@ -552,6 +591,7 @@ class HttpFrontEnd:
                 model_key = await asyncio.get_running_loop(
                 ).run_in_executor(None, self.model_router, raw_model)
             except KeyError as e:
+                self._abort_trace(trace)
                 counts["rejected"] += 1
                 self.admission.record_rejected(tenant)
                 self._respond(writer, 404, {
@@ -567,6 +607,7 @@ class HttpFrontEnd:
             # a malformed body is neither completed nor shed — its own
             # ledger column, so `completed + shed + failed + rejected
             # == submitted` survives bad clients
+            self._abort_trace(trace)
             counts["rejected"] += 1
             self.admission.record_rejected(tenant)
             self._respond(
@@ -578,8 +619,9 @@ class HttpFrontEnd:
             # pool runner groups each coalesced batch by model key
             payload = (model_key, payload)
         try:
-            fut = self.batcher.submit(payload, priority=priority)
+            fut = self.batcher.submit(payload, priority=priority, trace=trace)
         except LoadShedError as e:
+            self._abort_trace(trace)
             self.admission.record_shed(tenant)
             counts[_shed_key(e.reason)] += 1
             self._respond(
@@ -597,6 +639,7 @@ class HttpFrontEnd:
             # between submit and execution is the belt-and-braces
             # case — either way an explicit shed, never a dropped
             # connection, ledgered under its real reason
+            self._abort_trace(trace)
             self.admission.record_shed(tenant)
             counts[_shed_key(e.reason)] += 1
             self._respond(
@@ -606,6 +649,7 @@ class HttpFrontEnd:
             )
             return
         except Exception as e:
+            self._abort_trace(trace)
             self.admission.record_failed(tenant)
             counts["failed"] += 1
             self._respond(
@@ -631,6 +675,11 @@ class HttpFrontEnd:
             "latency_ms": round(lat_ms, 3),
         })
         await writer.drain()
+        if trace is not None:
+            # respond span: future wakeup + encode + socket write; the
+            # waterfall is complete once the bytes are flushed
+            trace.stamp("respond")
+            self.tracer.finish(trace)
 
     # -- reporting -----------------------------------------------------
 
@@ -660,6 +709,12 @@ class HttpFrontEnd:
                 + c["shed_queue_full"] + c["shed_unavailable"]
                 for c in self._counts_by_priority
             ],
+            # live request-path stage histograms (obs/rtrace.py): the
+            # per-stage p99s /statsz clients and `watch` read to tell
+            # queue-bound from device-bound WHILE it happens
+            "rtrace": (
+                self.tracer.stats() if self.tracer is not None else None
+            ),
         })
 
     def accounting(self) -> Dict[str, Any]:
@@ -809,9 +864,28 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             "packed_impl": cfg.packed_impl,
             "resident_models": cfg.resident_models,
             "models": list(cfg.models) or None,
+            "rtrace": cfg.rtrace,
+            "rtrace_sample_every": cfg.rtrace_sample_every,
         },
     )
     events = EventWriter(run_dir, max_bytes=int(cfg.events_max_mb * 2**20))
+
+    # request-path tracing (obs/rtrace.py): full socket-to-socket
+    # waterfalls — read/admit/queue/coalesce/dispatch/compute/respond —
+    # with deterministic sampling + always-kept tail exemplars; sampled
+    # waterfalls and periodic stage histograms flow as rtrace events
+    tracer = None
+    if cfg.rtrace:
+        from bdbnn_tpu.obs.rtrace import RequestTracer
+
+        tracer = RequestTracer(
+            seed=cfg.seed,
+            sample_every=cfg.rtrace_sample_every,
+            tail_k=cfg.rtrace_tail_k,
+            on_sample=lambda wf: events.emit(
+                "rtrace", phase="request", **wf
+            ),
+        )
 
     default_rate, default_burst = parse_quota(cfg.default_quota)
     admission = AdmissionController(
@@ -991,6 +1065,7 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         port=cfg.port,
         max_body_bytes=int(cfg.max_body_mb * 2**20),
         model_router=model_router,
+        tracer=tracer,
     )
     host, port = front.start()
     events.emit(
@@ -1145,6 +1220,10 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
                     "replica", phase="stats",
                     **replica_stats_fields(pool.stats()),
                 )
+            if tracer is not None:
+                # the live stage histograms: `watch` renders the
+                # per-stage p99 waterfall from this heartbeat
+                events.emit("rtrace", phase="stats", **tracer.stats())
 
     pump = threading.Thread(target=stats_pump, daemon=True)
     pump.start()
@@ -1331,6 +1410,9 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         swap=admin.swap_report() if admin is not None else None,
         resident=resident_final,
         packed=packed_block,
+        attribution=(
+            tracer.attribution() if tracer is not None else None
+        ),
     )
     events.emit("serve", phase="verdict", **verdict)
     events.emit("http", phase="stop", host=host, port=port)

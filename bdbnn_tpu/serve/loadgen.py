@@ -70,7 +70,13 @@ VERDICT_NAME = "verdict.json"
 # rollout disposition: versions, shed-due-to-swap, completed-by-
 # version ledger). All three are null on single-replica runs, so v1/v2
 # consumers keep working unchanged.
-VERDICT_SCHEMA_VERSION = 3
+# v4: the request-path ``attribution`` block (obs/rtrace.py) —
+# per-priority p50/p99 decomposed by lifecycle stage (read/admit/
+# queue/coalesce/dispatch/compute/respond), the stage-sum-vs-e2e
+# reconciliation identity, the slowest-K tail-exemplar waterfalls per
+# priority and the two-clock documentation. Null when tracing is off,
+# so v1-v3 consumers keep working unchanged.
+VERDICT_SCHEMA_VERSION = 4
 
 
 def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -677,6 +683,7 @@ def slo_verdict(
     swap: Optional[Dict[str, Any]] = None,
     resident: Optional[Dict[str, Any]] = None,
     packed: Optional[Dict[str, Any]] = None,
+    attribution: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble the deterministic strict-JSON SLO verdict.
 
@@ -697,7 +704,12 @@ def slo_verdict(
     and ``packed`` (the packed-vs-dense A/B: resident squeeze ratio +
     the honest per-step time on each side, ``serve_packed_step_ms``).
     Both are null on pre-packed runs, so v1/v2/v3-without-packed
-    verdicts skip the new metrics cleanly."""
+    verdicts skip the new metrics cleanly. Request-path tracing
+    (obs/rtrace.py) adds the v4 ``attribution`` block: per-priority
+    p50/p99 decomposed by lifecycle stage, the stage-sum-vs-e2e
+    reconciliation identity and the tail-exemplar waterfalls — the
+    block ``compare`` reads its stage-share metrics from. Null when
+    tracing is off."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
@@ -736,6 +748,7 @@ def slo_verdict(
         "swap": swap,
         "resident": resident,
         "packed": packed,
+        "attribution": attribution,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (
@@ -768,6 +781,7 @@ def http_slo_verdict(
     swap: Optional[Dict[str, Any]] = None,
     resident: Optional[Dict[str, Any]] = None,
     packed: Optional[Dict[str, Any]] = None,
+    attribution: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Build the v2 verdict from the HTTP front end's request ledger
     (:meth:`serve.http.HttpFrontEnd.accounting`), the batcher's
@@ -858,6 +872,7 @@ def http_slo_verdict(
         swap=swap,
         resident=resident,
         packed=packed,
+        attribution=attribution,
     )
 
 
@@ -931,6 +946,8 @@ def _bench_manifest_fields(cfg, engine, prov, recipe) -> Dict[str, Any]:
         "requests": cfg.requests,
         "concurrency": cfg.concurrency,
         "seed": cfg.seed,
+        "rtrace": cfg.rtrace,
+        "rtrace_sample_every": cfg.rtrace_sample_every,
     }
 
 
@@ -1079,6 +1096,7 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
     throughput: Dict[str, float] = {}
     passes: Dict[int, Any] = {}
     caches_per_pass: Dict[int, Any] = {}
+    tracers: Dict[int, Any] = {}
     for n in sweep:
         if handler.preempted:
             break
@@ -1134,14 +1152,35 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
                     shed=stats["shed"],
                 )
 
+        # request-path tracing (obs/rtrace.py): every submission gets a
+        # queue -> coalesce -> dispatch -> compute waterfall; sampled
+        # exemplars + periodic stage histograms flow as rtrace events
+        tracer = None
+        if cfg.rtrace:
+            from bdbnn_tpu.obs.rtrace import RequestTracer
+
+            tracer = RequestTracer(
+                seed=cfg.seed,
+                sample_every=cfg.rtrace_sample_every,
+                tail_k=cfg.rtrace_tail_k,
+                on_sample=lambda wf: events.emit(
+                    "rtrace", phase="request", **wf
+                ),
+            )
+            tracers[n] = tracer
+
         pump_stop = threading.Event()
 
-        def pump(pool=pool):
+        def pump(pool=pool, tracer=tracer):
             while not pump_stop.wait(0.5):
                 events.emit(
                     "replica", phase="stats",
                     **replica_stats_fields(pool.stats()),
                 )
+                if tracer is not None:
+                    events.emit(
+                        "rtrace", phase="stats", **tracer.stats()
+                    )
 
         t_pump = threading.Thread(
             target=pump, name="bench-replica-stats", daemon=True
@@ -1160,7 +1199,8 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
             max_pending_batches=2 * n,
         )
         gen = LoadGenerator(
-            batcher.submit,
+            tracer.bind(batcher.submit) if tracer is not None
+            else batcher.submit,
             sample_fn,
             mode=cfg.mode,
             requests=cfg.requests,
@@ -1250,6 +1290,12 @@ def _serve_bench_pool(cfg, handler, sweep) -> Dict[str, Any]:
         replicas=_pool_replicas_block(pool_stats),
         scaling=scaling,
         resident=resident,
+        # attribution from the LARGEST measured pass — the same pass
+        # every other aggregate in this verdict reports
+        attribution=(
+            tracers[max(passes)].attribution()
+            if passes and max(passes) in tracers else None
+        ),
     )
     events.emit("serve", phase="verdict", **verdict)
     events.close()
@@ -1401,8 +1447,25 @@ def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
         ).astype(np.float32)
         sample_fn = lambda i: pool[i % len(pool)]
 
+        # request-path tracing (obs/rtrace.py): queue -> coalesce ->
+        # compute waterfalls per request (no socket, so no read/admit/
+        # respond; no pool, so the dispatch stage stays empty -> null)
+        tracer = None
+        if cfg.rtrace:
+            from bdbnn_tpu.obs.rtrace import RequestTracer
+
+            tracer = RequestTracer(
+                seed=cfg.seed,
+                sample_every=cfg.rtrace_sample_every,
+                tail_k=cfg.rtrace_tail_k,
+                on_sample=lambda wf, label=label: events.emit(
+                    "rtrace", phase="request", weights_mode=label, **wf
+                ),
+            )
+
         gen = LoadGenerator(
-            batcher.submit,
+            tracer.bind(batcher.submit) if tracer is not None
+            else batcher.submit,
             sample_fn,
             mode=cfg.mode,
             requests=cfg.requests,
@@ -1415,6 +1478,11 @@ def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
         # graceful drain: accepted requests are all answered before
         # the verdict is written — on SIGTERM this is the whole point
         drained_clean = batcher.drain(timeout=120.0)
+        if tracer is not None:
+            events.emit(
+                "rtrace", phase="stats", weights_mode=label,
+                **tracer.stats(),
+            )
         wall = max(raw["wall_s"], 1e-9)
         passes[label] = {
             "raw": raw,
@@ -1423,6 +1491,11 @@ def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
             "warmup_s": warmup_s,
             "residency": residency,
             "step_ms": step_ms,
+            # the engine's own blocked-compute window under the real
+            # interleave — the compute-stage cross-check attribution
+            # cites next to the idle time_step calibration
+            "step_stats": engine.step_stats(),
+            "tracer": tracer,
             "throughput_rps": round(raw["completed"] / wall, 3),
             "p99_ms": _pct(raw["latencies_ms"], 99.0),
         }
@@ -1496,6 +1569,18 @@ def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
         primary["residency"], completed=primary["raw"]["completed"]
     )
 
+    attribution = None
+    if primary.get("tracer") is not None:
+        attribution = primary["tracer"].attribution(
+            device={
+                # blocked-compute cross-check: idle calibration (the
+                # time_step mean) next to the window measured under
+                # the real serving interleave
+                "time_step_ms": primary["step_ms"],
+                **primary["step_stats"],
+            }
+        )
+
     verdict = slo_verdict(
         primary["raw"],
         primary["batcher_stats"],
@@ -1510,6 +1595,7 @@ def _serve_bench_single(cfg, handler) -> Dict[str, Any]:
         drained_clean=all(p["drained_clean"] for p in passes.values()),
         resident=resident,
         packed=packed_block,
+        attribution=attribution,
     )
     events.emit("serve", phase="verdict", **verdict)
     events.close()

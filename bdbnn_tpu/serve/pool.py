@@ -52,6 +52,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from bdbnn_tpu.obs.rtrace import set_future_timing
 from bdbnn_tpu.serve.batching import LoadShedError
 
 # replica states: dispatchable is READY only
@@ -75,7 +76,11 @@ class _Work:
     def __init__(self, payloads):
         self.payloads = payloads
         self.future: Future = Future()
-        self.t_enqueue = time.monotonic()
+        # perf_counter, matching the request tracer's clock: the
+        # dispatch-wait span (submit -> worker pickup) is handed back
+        # on the batch Future (obs/rtrace.py) and must never mix clock
+        # bases with the batcher's stamps
+        self.t_enqueue = time.perf_counter()
 
 
 class Replica:
@@ -158,6 +163,12 @@ class Replica:
                 # at pickup: a concurrent swap must not relabel it
                 version = self.version
                 runner = self._runner
+            # dispatch-wait span: submit -> this pickup (replica-queue
+            # time under backpressure); compute span: the engine call
+            # itself. Both ride the batch Future so the front batcher
+            # can attribute them per request (obs/rtrace.py).
+            t_pick = time.perf_counter()
+            dispatch_ms = (t_pick - work.t_enqueue) * 1000.0
             try:
                 results = runner(work.payloads)
             except Exception as e:
@@ -180,6 +191,10 @@ class Replica:
                 self.batches += 1
                 self.completed += len(work.payloads)
             if not work.future.done():
+                set_future_timing(
+                    work.future, dispatch_ms,
+                    (time.perf_counter() - t_pick) * 1000.0,
+                )
                 work.future.set_result(results)
             if self._on_done is not None:
                 try:

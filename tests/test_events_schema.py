@@ -92,11 +92,14 @@ class TestEmitCallSites:
         # two network-front-end kinds (serve/http.py) and the two
         # replica-pool kinds (serve/http.py's replica heartbeat + the
         # swap trigger), which must keep real call sites
+        # ... and the request-path tracing kind (serve/http.py +
+        # serve/loadgen.py sampled waterfalls and stats heartbeats)
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
                 "alert", "health", "export", "serve",
-                "http", "admission", "replica", "swap"} <= found
+                "http", "admission", "replica", "swap",
+                "rtrace"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync."""
@@ -358,6 +361,63 @@ class TestStrictRfc8259:
         # the emit() return values match what was written
         assert u["busy_s"] is None and r["restarts"] == 1
         assert s["seconds"] is None and t["phase"] == "failed"
+
+    def test_rtrace_kind_payloads_roundtrip(self, tmp_path):
+        """The request-path tracing payload shapes (obs/rtrace.py via
+        serve/http.py + serve/loadgen.py) with adversarial values in
+        the numeric slots: a NaN stage ms in a waterfall must land as
+        null, numpy counters must unwrap, and the nested stage-p99 /
+        per-priority / waterfall structures must survive strict
+        parsing."""
+        ev = EventWriter(str(tmp_path))
+        w = ev.emit(
+            "rtrace",
+            phase="request",
+            seq=np.int64(123),
+            priority=np.int64(0),
+            tenant="tenant-a",
+            total_ms=np.float32(14.25),
+            stages={
+                "read": np.float32(0.5),
+                "admit": 0.01,
+                "queue": float("nan"),
+                "coalesce": np.float32(1.0),
+                "compute": np.float64(11.5),
+                "respond": float("inf"),
+            },
+        )
+        s = ev.emit(
+            "rtrace",
+            phase="stats",
+            requests=np.int64(1200),
+            aborted=0,
+            sampled=np.int64(75),
+            stage_p99_ms={
+                "read": np.float32(0.4),
+                "queue": float("nan"),
+                "dispatch": None,
+                "compute": np.float64(12.5),
+            },
+            e2e_p99_ms_by_priority={
+                "0": np.float32(13.0), "2": float("inf"),
+            },
+            queue_share=np.float32(0.31),
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "rtrace"
+        assert lines[0]["seq"] == 123
+        assert isinstance(lines[0]["seq"], int)
+        assert lines[0]["stages"]["queue"] is None  # NaN -> null
+        assert lines[0]["stages"]["respond"] is None  # Inf -> null
+        assert lines[0]["stages"]["compute"] == 11.5
+        assert lines[1]["stage_p99_ms"]["queue"] is None
+        assert lines[1]["stage_p99_ms"]["dispatch"] is None
+        assert lines[1]["e2e_p99_ms_by_priority"]["2"] is None
+        assert lines[1]["queue_share"] == pytest.approx(0.31, abs=1e-3)
+        # the emit() return values match what was written
+        assert w["stages"]["queue"] is None and s["requests"] == 1200
 
     def test_resilience_kind_payloads_roundtrip(self, tmp_path):
         """The extended pod-resilience payload shapes (train/loop.py):

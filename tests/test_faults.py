@@ -221,9 +221,18 @@ class TestSigtermPreemption:
         assert man["restart_lineage"] == [os.path.abspath(victim_dir)]
 
 
+@pytest.mark.slow
 class TestSigkillResume:
     """Hard kill: no handler, no cleanup — only the committed mid-epoch
-    checkpoint survives. The acceptance-criteria test."""
+    checkpoint survives. The acceptance-criteria test.
+
+    tier-1 budget (PR 10 rebalance): rides the slow tier with the
+    randomized SIGKILL matrix and the pod-SIGKILL variant it fronts —
+    hard-kill survivability keeps denser tier-1 coverage via the
+    deterministic crash-at-every-commit-phase matrix
+    (test_checkpoint), the in-process SIGTERM preempt->resume e2e
+    (TestSigtermPreemption) and the coordinated pod preemption e2e
+    (test_pod_faults)."""
 
     @pytest.fixture(scope="class")
     def killed(self, tmp_path_factory):

@@ -377,10 +377,16 @@ class TestForensicsAtEpochEnd:
 
 
 class TestHealthyFitNoAlerts:
+    @pytest.mark.slow
     def test_healthy_seed_run_fires_nothing(self, tmp_path):
         """End-to-end false-positive guard: a healthy (default-config)
         synthetic fit with real probes emits zero alerts and a clean
-        health roll-up."""
+        health roll-up.
+
+        tier-1 budget (PR 10 rebalance): the broad fit()-smoke variant
+        of the guard rides slow; the per-detector healthy-STREAM
+        false-positive guard (test_healthy_stream_no_alerts) keeps the
+        denser tier-1 coverage over the same detector set."""
         from bdbnn_tpu.train.loop import fit
 
         fit(RunConfig(

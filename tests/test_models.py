@@ -366,10 +366,17 @@ class TestRegistrySurface:
         for name in list_models("imagenet"):
             assert name in msg
 
+    @pytest.mark.slow
     def test_bottleneck_teachers_match_torchvision_param_counts(self):
         """resnet50_float / resnet101_float are exact structural twins
         of torchvision resnet50/101 (param-for-param), so their
-        checkpoints ingest strictly."""
+        checkpoints ingest strictly.
+
+        tier-1 budget (PR 10 rebalance): initializing both bottleneck
+        giants costs ~15s of pure construction; the ingest contract
+        keeps denser tier-1 coverage via the torch-import strict-load
+        tests and the bottleneck-is-float-only pin below, and the
+        bottleneck archs' BN-fold cases already ride slow (PR 5)."""
         expected = {"resnet50_float": 25_557_032,
                     "resnet101_float": 44_549_160}
         for arch, want in expected.items():

@@ -909,10 +909,12 @@ class TestVerdictV3Compare:
              "latencies_ms": [1.0]},
             {}, mode="open", rate=1.0, seed=0,
         )
-        assert v["serve_verdict"] == 3
+        assert v["serve_verdict"] == 4
         # v1/v2 consumers: the v3 blocks exist but are null
         assert v["replicas"] is None
         assert v["scaling"] is None and v["swap"] is None
+        # and the v4 attribution block is null when tracing is off
+        assert v["attribution"] is None
 
     def test_scaling_efficiency_regression_judged(self, tmp_path):
         from bdbnn_tpu.obs.compare import compare_runs
@@ -1123,7 +1125,7 @@ class TestScalingSweep:
         )
         res = run_serve_bench(cfg)
         v = res["verdict"]
-        assert v["serve_verdict"] == 3
+        assert v["serve_verdict"] == 4
         scaling = v["scaling"]
         assert scaling["replicas"] == [1, 2, 4, 8]
         assert scaling["monotone"] is True, scaling
@@ -1342,7 +1344,7 @@ class TestSwapUnderFlashCrowdEndToEnd:
             r["version"] == "v0002"
             for r in v["replicas"]["per_replica"]
         )
-        assert v["serve_verdict"] == 3
+        assert v["serve_verdict"] == 4
 
     def test_events_watch_summarize_compare_consume_the_swap(
         self, swap_run, tmp_path

@@ -1,0 +1,598 @@
+"""Request-path tracing tests (obs/rtrace.py + its threading through
+serve/batching.py, serve/pool.py, serve/admission.py, serve/http.py
+and the v4 verdict):
+
+- trace arithmetic (stamp/add/sync) and the waterfall payload shape
+- deterministic seeded sampling + always-kept slowest-K tail exemplars
+- the empty-stage-window -> null pin (the hardened None-propagating
+  percentile helpers from serve/loadgen.py, never a TypeError)
+- THE reconciliation identity: per-request stage sums match the
+  server-side end-to-end latency within tolerance, on both the
+  single-engine (sync) and replica-pool (async dispatch/compute
+  split) paths — no mixed-clock arithmetic anywhere in a request
+- the full socket-to-socket waterfall over a real HTTP front end,
+  /statsz live histograms included
+- compare's stage-share gates: an injected queue-wait regression
+  flips the verdict to regression (exit 3) even when the aggregate
+  p99 is flat
+- a `slow`-marked overhead benchmark pinning sampled tracing under
+  the 2% budget on a pacing-dominated load
+"""
+
+import json
+import time
+
+import pytest
+
+from bdbnn_tpu.obs.rtrace import (
+    RECON_TOL_PCT,
+    STAGES,
+    RequestTracer,
+    pop_future_timing,
+    set_future_timing,
+)
+from bdbnn_tpu.serve.batching import MicroBatcher
+from bdbnn_tpu.serve.loadgen import LoadGenerator, slo_verdict
+
+
+class TestTraceArithmetic:
+    def test_stamp_charges_gap_and_advances(self):
+        tracer = RequestTracer(seed=0)
+        tr = tracer.begin(1, "tenant-x")
+        time.sleep(0.005)
+        tr.stamp("read")
+        assert tr.stages["read"] >= 4.0
+        t_after_read = tr._last
+        tr.stamp("admit")
+        # the admit stamp only charged its own (tiny) gap
+        assert tr.stages["admit"] < tr.stages["read"]
+        assert tr._last >= t_after_read
+
+    def test_add_does_not_advance_cursor(self):
+        tracer = RequestTracer(seed=0)
+        tr = tracer.begin(0)
+        cursor = tr._last
+        tr.add("dispatch", 3.5)
+        tr.add("compute", 7.25)
+        assert tr._last == cursor
+        assert tr.stages["dispatch"] == 3.5
+        assert tr.stages["compute"] == 7.25
+        tr.sync()
+        assert tr._last > cursor
+
+    def test_waterfall_shape_and_stage_order(self):
+        tracer = RequestTracer(seed=0)
+        tr = tracer.begin(2, "t")
+        tr.stamp("read")
+        tr.add("compute", 1.0)
+        wf = tr.waterfall()
+        assert wf["priority"] == 2 and wf["tenant"] == "t"
+        assert set(wf["stages"]) == {"read", "compute"}
+        # stages render in canonical taxonomy order
+        assert list(wf["stages"]) == [
+            s for s in STAGES if s in wf["stages"]
+        ]
+
+    def test_begin_seq_is_unique_and_monotone(self):
+        tracer = RequestTracer(seed=0)
+        seqs = [tracer.begin(0).seq for _ in range(10)]
+        assert seqs == list(range(10))
+
+
+def _finish_exact(tracer, priority, stages_ms):
+    """Feed one synthetic request whose end-to-end total EXACTLY
+    equals its stage sum (the cursor is pinned to t0 + sum, so the
+    reconciliation identity holds by construction — these tests are
+    about the rollups, not the clock)."""
+    tr = tracer.begin(priority)
+    for stage, ms in stages_ms.items():
+        tr.add(stage, ms)
+    tr._last = tr.t0 + sum(stages_ms.values()) / 1000.0
+    tracer.finish(tr)
+    return tr
+
+
+class TestSamplingAndTail:
+    def test_sampling_is_deterministic_per_seed(self):
+        kept_a = [
+            RequestTracer(seed=7, sample_every=4)._keep(i)
+            for i in range(200)
+        ]
+        kept_b = [
+            RequestTracer(seed=7, sample_every=4)._keep(i)
+            for i in range(200)
+        ]
+        kept_c = [
+            RequestTracer(seed=8, sample_every=4)._keep(i)
+            for i in range(200)
+        ]
+        assert kept_a == kept_b  # same seed -> same exemplar set
+        assert kept_a != kept_c  # a different seed picks differently
+        # the rate is roughly 1/sample_every (hash, not stride)
+        assert 20 <= sum(kept_a) <= 80
+
+    def test_sample_every_one_keeps_everything(self):
+        hits = []
+        tracer = RequestTracer(
+            seed=0, sample_every=1, on_sample=hits.append
+        )
+        for _ in range(5):
+            _finish_exact(tracer, 0, {"queue": 1.0, "compute": 2.0})
+        assert len(hits) == 5
+        assert tracer.sampled == 5
+
+    def test_tail_keeps_slowest_k_regardless_of_sampling(self):
+        # sample_every huge: nothing sampled, the tail still fills
+        tracer = RequestTracer(seed=0, sample_every=10**6, tail_k=3)
+        totals = [5.0, 50.0, 1.0, 30.0, 2.0, 40.0, 3.0]
+        for t in totals:
+            _finish_exact(tracer, 0, {"compute": t})
+        att = tracer.attribution()
+        tail = att["tail"]["0"]
+        assert [wf["total_ms"] for wf in tail] == [50.0, 40.0, 30.0]
+        assert att["sampled"] == 0  # the tail is sampling-independent
+
+    def test_aborted_requests_stay_out_of_histograms(self):
+        tracer = RequestTracer(seed=0)
+        tr = tracer.begin(0)
+        tr.stamp("read")
+        tracer.abort(tr)
+        assert tracer.aborted == 1 and tracer.finished == 0
+        att = tracer.attribution()
+        assert att["stages"]["read"] is None  # a 503 is not a serve
+
+
+class TestEmptyStageNull:
+    def test_empty_stage_window_lands_null_never_typeerror(self):
+        """THE satellite pin: the verdict's stage blocks reuse the
+        hardened None-propagating percentile helpers — a stage nothing
+        measured (dispatch on the single-engine path; everything on a
+        zero-request run) is null in strict JSON, never a crash."""
+        tracer = RequestTracer(seed=0)
+        # zero requests: every block null, reconciliation unjudged
+        att = tracer.attribution()
+        assert all(att["stages"][s] is None for s in STAGES)
+        assert att["reconciliation"]["ok"] is None
+        assert att["queue_share"] is None
+        json.dumps(att, allow_nan=False)
+        # some requests, but never a dispatch span (no pool)
+        _finish_exact(tracer, 0, {"queue": 1.0, "compute": 2.0})
+        att = tracer.attribution()
+        assert att["stages"]["dispatch"] is None
+        assert att["stages"]["queue"]["p99_ms"] == 1.0
+        v = slo_verdict(
+            {"submitted": 1, "completed": 1, "shed": 0, "wall_s": 1.0,
+             "latencies_ms": [3.0]},
+            {}, mode="open", rate=1.0, seed=0, attribution=att,
+        )
+        line = json.dumps(v, allow_nan=False)
+        parsed = json.loads(
+            line, parse_constant=lambda s: pytest.fail(f"bare {s}")
+        )
+        assert parsed["attribution"]["stages"]["dispatch"] is None
+
+    def test_stats_snapshot_is_strict_json_safe(self):
+        tracer = RequestTracer(seed=0)
+        s = tracer.stats()
+        assert s["queue_share"] is None
+        json.dumps(s, allow_nan=False)
+
+
+class TestReconciliationBatcher:
+    """The identity over the REAL micro-batcher: stage sums match the
+    measured end-to-end latency within tolerance on both runner
+    shapes. All spans ride one perf_counter timeline — there is no
+    cross-clock subtraction anywhere in a request."""
+
+    def test_sync_runner_path(self):
+        tracer = RequestTracer(seed=0, sample_every=1, tail_k=5)
+
+        def runner(batch):
+            time.sleep(0.02)
+            return list(batch)
+
+        b = MicroBatcher(
+            runner, max_batch=8, max_queue=64, max_delay_ms=2.0
+        )
+        gen = LoadGenerator(
+            tracer.bind(b.submit), lambda i: i,
+            mode="open", requests=40, rate=400.0, seed=0,
+        )
+        raw = gen.run()
+        assert b.drain(timeout=30.0)
+        assert raw["completed"] == 40
+        att = tracer.attribution()
+        recon = att["reconciliation"]
+        assert recon["requests"] == 40
+        assert recon["ok"] is True, recon
+        assert recon["mean_abs_err_pct"] <= RECON_TOL_PCT
+        # the waterfall stages a batcher-only path can populate
+        assert att["stages"]["queue"] is not None
+        assert att["stages"]["coalesce"] is not None
+        assert att["stages"]["compute"] is not None
+        assert att["stages"]["dispatch"] is None  # no pool, no hop
+        # per-request identity on the kept tail exemplars
+        for wf in att["tail"]["0"]:
+            stage_sum = sum(wf["stages"].values())
+            assert stage_sum == pytest.approx(
+                wf["total_ms"], rel=RECON_TOL_PCT / 100.0, abs=0.5,
+            )
+
+    def test_pool_async_path_splits_dispatch_and_compute(self):
+        from bdbnn_tpu.serve.pool import ReplicaPool
+
+        tracer = RequestTracer(seed=0, sample_every=1, tail_k=5)
+
+        def factory(ref, dev):
+            def r(payloads):
+                time.sleep(0.01)
+                return list(payloads)
+
+            return r
+
+        pool = ReplicaPool(
+            factory, ["d0", "d1"], max_queue_batches=4
+        )
+        b = MicroBatcher(
+            pool.submit, max_batch=4, max_queue=64, max_delay_ms=1.0,
+            max_pending_batches=4,
+        )
+        gen = LoadGenerator(
+            tracer.bind(b.submit), lambda i: i,
+            mode="open", requests=40, rate=600.0, seed=1,
+        )
+        raw = gen.run()
+        assert b.drain(timeout=30.0)
+        assert pool.drain(timeout=30.0)
+        assert raw["completed"] == 40
+        att = tracer.attribution()
+        # the pool path measures the dispatch hop the sync path lacks
+        assert att["stages"]["dispatch"] is not None
+        assert att["stages"]["compute"] is not None
+        recon = att["reconciliation"]
+        assert recon["ok"] is True, recon
+
+    def test_future_timing_handoff_is_consumed_once(self):
+        from concurrent.futures import Future
+
+        fut = Future()
+        set_future_timing(fut, 1.5, 2.5)
+        assert pop_future_timing(fut) == (1.5, 2.5)
+        assert pop_future_timing(fut) is None  # consumed, not sticky
+
+
+class TestHttpWaterfall:
+    """The full socket-to-socket lifecycle over a REAL front end:
+    read/admit/queue/coalesce/compute/respond all populated, /statsz
+    mirrors the live histograms, the per-priority decomposition
+    reconciles with the server-side end-to-end latency."""
+
+    def _drive(self, fe, n, priorities=(0, 1)):
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            fe.host, fe.port, timeout=30
+        )
+        for i in range(n):
+            conn.request(
+                "POST", "/v1/predict",
+                body=json.dumps([i]).encode(),
+                headers={
+                    "x-priority": str(priorities[i % len(priorities)]),
+                    "x-tenant": "tenant-a",
+                },
+            )
+            r = conn.getresponse()
+            assert r.status == 200, r.read()
+            r.read()
+        conn.request("GET", "/statsz")
+        statsz = json.loads(conn.getresponse().read())
+        conn.close()
+        return statsz
+
+    def test_full_waterfall_and_statsz(self, http_frontend):
+        samples = []
+        tracer = RequestTracer(
+            seed=0, sample_every=1, tail_k=3, on_sample=samples.append
+        )
+
+        def runner(batch):
+            time.sleep(0.01)
+            return list(batch)
+
+        fe = http_frontend(runner, tracer=tracer, max_delay_ms=2.0)
+        statsz = self._drive(fe, 14)
+        # /statsz mirrors the live stage histograms
+        rt = statsz["rtrace"]
+        assert rt["requests"] == 14
+        for stage in ("read", "admit", "queue", "coalesce",
+                      "compute", "respond"):
+            assert rt["stage_p99_ms"][stage] is not None, stage
+        assert rt["stage_p99_ms"]["dispatch"] is None  # no pool
+        assert set(rt["e2e_p99_ms_by_priority"]) == {"0", "1"}
+        assert len(samples) == 14  # sample_every=1: every waterfall
+        att = tracer.attribution()
+        # the acceptance identity: per-priority stage decomposition
+        # reconciles with the measured server-side latency within 5%
+        recon = att["reconciliation"]
+        assert recon["requests"] == 14
+        assert recon["ok"] is True, recon
+        for p in ("0", "1"):
+            blocks = att["per_priority"][p]["stages"]
+            for stage in ("read", "admit", "queue", "coalesce",
+                          "compute", "respond"):
+                assert blocks[stage] is not None, (p, stage)
+        for wf in att["tail"]["0"] + att["tail"]["1"]:
+            stage_sum = sum(wf["stages"].values())
+            assert stage_sum == pytest.approx(
+                wf["total_ms"], rel=RECON_TOL_PCT / 100.0, abs=0.5,
+            )
+        # both clock bases are documented in the verdict block
+        assert "perf_counter" in att["clocks"]["server"]
+        assert "SCHEDULED" in att["clocks"]["client"]
+
+    def test_shed_and_rejected_requests_abort_not_pollute(
+        self, http_frontend
+    ):
+        import http.client
+
+        tracer = RequestTracer(seed=0, sample_every=1)
+        fe = http_frontend(
+            lambda batch: list(batch),
+            tracer=tracer,
+            default_rate=0.0, default_burst=1.0,  # 1 request, no refill
+        )
+        conn = http.client.HTTPConnection(fe.host, fe.port, timeout=30)
+        statuses = []
+        for i in range(3):
+            conn.request(
+                "POST", "/v1/predict", body=b"[1]",
+                headers={"x-priority": "0"},
+            )
+            r = conn.getresponse()
+            statuses.append(r.status)
+            r.read()
+        conn.close()
+        assert statuses == [200, 429, 429]
+        assert tracer.finished == 1
+        assert tracer.aborted == 2  # over-quota 429s never enter stats
+        att = tracer.attribution()
+        assert att["per_priority"]["0"]["e2e"]["n"] == 1
+
+
+def _attributed_verdict(tmp_path, name, *, queue_ms, compute_ms,
+                        lat_p99=30.0, n=60):
+    """A v4 verdict file whose aggregate latency is FIXED while the
+    stage decomposition varies — the 'p99 flat, decomposition moved'
+    construction the stage-share gates exist for."""
+    tracer = RequestTracer(seed=0, sample_every=16, tail_k=3)
+    for _ in range(n):
+        _finish_exact(
+            tracer, 0, {"queue": queue_ms, "compute": compute_ms}
+        )
+    lats = sorted([lat_p99 * 0.5] * (n - 1) + [lat_p99])
+    v = slo_verdict(
+        {"submitted": n, "completed": n, "shed": 0, "wall_s": 1.0,
+         "latencies_ms": lats},
+        {"mean_occupancy": 0.5, "batches": 8,
+         "max_queue_depth_seen": 4, "max_queue": 64},
+        mode="open", rate=100.0, seed=0,
+        provenance={"recipe": {"arch": "resnet8_tiny",
+                               "dataset": "cifar10"}},
+        attribution=tracer.attribution(),
+    )
+    path = tmp_path / name
+    path.write_text(json.dumps(v))
+    return str(path)
+
+
+class TestCompareStageGates:
+    def test_queue_wait_regression_flips_exit_even_with_flat_p99(
+        self, tmp_path
+    ):
+        """THE acceptance gate: an injected queue-wait regression (the
+        decomposition moved from device-bound to queue-bound — a
+        wedged worker or a shrunk replica queue looks exactly like
+        this) flips compare to regression while the aggregate p99 and
+        throughput hold."""
+        from bdbnn_tpu.obs.compare import compare_runs
+
+        base = _attributed_verdict(
+            tmp_path, "base.json", queue_ms=2.0, compute_ms=25.0,
+        )
+        cand = _attributed_verdict(
+            tmp_path, "cand.json", queue_ms=25.0, compute_ms=2.0,
+        )
+        result = compare_runs([base, cand])
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        # the aggregate SLO is identical on both sides...
+        assert rows["serve_p99_ms"]["verdict"] == "ok"
+        assert rows["serve_throughput_rps"]["verdict"] == "ok"
+        # ...but the stage decomposition regressed: exit 3
+        assert rows["serve_p99_queue_ms"]["verdict"] == "regression"
+        assert rows["serve_queue_share"]["verdict"] == "regression"
+        assert result["verdict"] == "regression"
+        # and the mirror image improves, never regresses
+        back = compare_runs([cand, base])
+        rows = {
+            m["metric"]: m
+            for m in back["comparisons"][0]["metrics"]
+        }
+        assert rows["serve_p99_queue_ms"]["verdict"] == "improvement"
+        # (the mirror's OVERALL verdict still flags the compute-stage
+        # increase — the gates are symmetric, each stage judged on its
+        # own axis)
+        assert rows["serve_p99_compute_ms"]["verdict"] == "regression"
+        # a self-compare is clean on every stage metric
+        self_cmp = compare_runs([base, base])
+        assert self_cmp["verdict"] == "pass"
+
+    def test_pre_v4_verdicts_skip_stage_metrics_cleanly(self, tmp_path):
+        """v1-v3 verdicts (and traced-off v4 runs) carry no
+        attribution block: the stage metrics land None on both sides
+        -> no row, never a phantom verdict (pinned per the satellite)."""
+        from bdbnn_tpu.obs.compare import _serve_metrics, compare_runs
+
+        old = {
+            "serve_verdict": 3,
+            "p99_ms": 10.0, "throughput_rps": 100.0, "shed_rate": 0.0,
+            "provenance": {"recipe": {"arch": "resnet8_tiny",
+                                      "dataset": "cifar10"}},
+        }
+        m = _serve_metrics(old)
+        assert m["serve_p99_queue_ms"] is None
+        assert m["serve_p99_compute_ms"] is None
+        assert m["serve_queue_share"] is None
+        a = tmp_path / "old_a.json"
+        b = tmp_path / "old_b.json"
+        a.write_text(json.dumps(old))
+        b.write_text(json.dumps(old))
+        result = compare_runs([str(a), str(b)])
+        judged = {
+            m["metric"]
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert "serve_p99_queue_ms" not in judged
+        assert "serve_queue_share" not in judged
+        assert result["verdict"] == "pass"
+
+    def test_v4_against_v3_baseline_skips_not_crashes(self, tmp_path):
+        from bdbnn_tpu.obs.compare import compare_runs
+
+        v3 = tmp_path / "v3.json"
+        v3.write_text(json.dumps({
+            "serve_verdict": 3,
+            "p99_ms": 30.0, "throughput_rps": 60.0, "shed_rate": 0.0,
+            "provenance": {"recipe": {"arch": "resnet8_tiny",
+                                      "dataset": "cifar10"}},
+        }))
+        v4 = _attributed_verdict(
+            tmp_path, "v4.json", queue_ms=25.0, compute_ms=2.0,
+        )
+        result = compare_runs([str(v3), v4])
+        judged = {
+            m["metric"]
+            for m in result["comparisons"][0]["metrics"]
+        }
+        # one side unknown -> the stage metrics are skipped
+        assert "serve_p99_queue_ms" not in judged
+
+
+class TestConsumersRenderAttribution:
+    def _run_dir(self, tmp_path):
+        """A serve-shaped run dir whose events carry rtrace stats and
+        a v4 verdict — what watch/summarize consume."""
+        from bdbnn_tpu.obs.events import EventWriter
+
+        tracer = RequestTracer(seed=0, sample_every=1, tail_k=2)
+        for _ in range(10):
+            _finish_exact(
+                tracer, 0, {"queue": 3.0, "compute": 9.0}
+            )
+        run_dir = tmp_path / "run"
+        ev = EventWriter(str(run_dir))
+        ev.emit("serve", phase="start", mode="open",
+                arch="resnet8_tiny", buckets=[1, 8],
+                queue_depth=64, requests=10)
+        ev.emit("rtrace", phase="stats", **tracer.stats())
+        v = slo_verdict(
+            {"submitted": 10, "completed": 10, "shed": 0,
+             "wall_s": 1.0, "latencies_ms": [12.0] * 10},
+            {"mean_occupancy": 0.5, "batches": 2},
+            mode="open", rate=10.0, seed=0,
+            attribution=tracer.attribution(),
+        )
+        ev.emit("serve", phase="verdict", **v)
+        ev.close()
+        return str(run_dir)
+
+    def test_watch_renders_live_and_final_waterfall(self, tmp_path):
+        from bdbnn_tpu.obs.events import read_events
+        from bdbnn_tpu.obs.watch import render_status
+
+        run_dir = self._run_dir(tmp_path)
+        events = read_events(run_dir)
+        # live view (pre-verdict): the stats heartbeat waterfall
+        live = render_status(
+            [e for e in events
+             if not (e.get("kind") == "serve"
+                     and e.get("phase") == "verdict")]
+        )
+        assert "trace: p99/stage ms" in live
+        assert "queue" in live and "compute" in live
+        # final view: the verdict's attribution waterfall + slowest
+        final = render_status(events)
+        assert "trace: p99/stage ms" in final
+        assert "slowest p0" in final
+        assert "RECONCILIATION BROKEN" not in final
+
+    def test_summarize_attribution_section(self, tmp_path):
+        from bdbnn_tpu.obs.summarize import summarize_run
+
+        run_dir = self._run_dir(tmp_path)
+        text, summary = summarize_run(run_dir)
+        att = summary["serving"]["verdict"]["attribution"]
+        assert att["requests"] == 10
+        assert att["reconciliation"]["ok"] is True
+        assert "trace: 10 requests traced" in text
+        assert "slowest p0" in text
+        json.dumps(summary, allow_nan=False)
+
+
+@pytest.mark.slow
+class TestTracingOverhead:
+    def test_sampled_tracing_overhead_under_budget(self):
+        """The acceptance budget: sampled tracing costs < 2% of the
+        serve-bench throughput verdict. End-to-end A/B throughput on a
+        micro-batcher is dominated by batch-formation timing noise
+        (one extra 5ms batch moves the wall more than the recorder
+        ever could — measured both directions run to run), so this
+        pins the budget the honest way: the recorder's measured
+        per-request lifecycle cost (begin + every stage stamp + the
+        finish rollup, amortized over the sampling rate) against the
+        bench's measured per-request wall at the serve-bench DEFAULT
+        load shape (open-loop Poisson at 100 req/s — the throughput
+        verdict the budget is stated against)."""
+        # 1. the bench's per-request wall at the default load shape
+        def runner(batch):
+            time.sleep(0.005)
+            return list(batch)
+
+        b = MicroBatcher(
+            runner, max_batch=16, max_queue=256, max_delay_ms=2.0
+        )
+        gen = LoadGenerator(
+            b.submit, lambda i: i,
+            mode="open", requests=120, rate=100.0, seed=0,
+        )
+        raw = gen.run()
+        assert b.drain(timeout=60.0)
+        assert raw["completed"] == 120
+        per_request_s = raw["wall_s"] / raw["completed"]
+
+        # 2. the recorder's own per-request cost at the default
+        # sampling config (the full serve-http stamp sequence)
+        tracer = RequestTracer(seed=0, sample_every=16, tail_k=5)
+        n = 5000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tr = tracer.begin(0, "tenant-a")
+            tr.stamp("read")
+            tr.stamp("admit")
+            tr.stamp("queue")
+            tr.stamp("coalesce")
+            tr.add("dispatch", 0.1)
+            tr.add("compute", 1.0)
+            tr.sync()
+            tr.stamp("respond")
+            tracer.finish(tr)
+        cost_s = (time.perf_counter() - t0) / n
+        overhead = cost_s / per_request_s
+        assert overhead < 0.02, (
+            f"tracing cost {cost_s * 1e6:.1f}us/request is "
+            f"{overhead:.2%} of the {per_request_s * 1e3:.2f}ms "
+            "bench per-request wall — over the 2% budget"
+        )
