@@ -887,6 +887,33 @@ def serve_http_main(argv) -> int:
         "the schedule has been offered (the swap-under-load bench); "
         "0 = no scheduled swap (POST /admin/swap still works)",
     )
+    ap.add_argument(
+        "--canary-fraction", type=float, default=0.0,
+        help="> 0 turns every triggered rollout into a CANARY rollout "
+        "(serve/canary.py): this traffic fraction routes to vN+1 on "
+        "--canary-replicas replicas while the live-verdict monitor "
+        "compares per-priority p99 / shed / fairness / queue-share / "
+        "logit-drift against the incumbent and auto-promotes or "
+        "auto-rolls-back (0 = classic unconditional blue/green)",
+    )
+    ap.add_argument(
+        "--canary-replicas", type=int, default=1,
+        help="replicas in the canary subset (default 1; must leave at "
+        "least one incumbent replica serving vN)",
+    )
+    ap.add_argument(
+        "--shadow-every", type=int, default=8,
+        help="mirror every Nth incumbent batch onto the canary and "
+        "diff the logits off the hot path — exact, because packed "
+        "inference is deterministic (default 8; 0 disables the probe)",
+    )
+    ap.add_argument(
+        "--canary-threshold", action="append", default=[],
+        metavar="NAME=VALUE", dest="canary_thresholds",
+        help="override a canary detector threshold or observation "
+        "knob (repeatable), e.g. --canary-threshold p99_ratio=3; "
+        "names are the serve.canary.CanaryConfig fields",
+    )
     ap.add_argument("--replica-queue-batches", type=int, default=8)
     ap.add_argument(
         "--wedge-timeout-s", type=float, default=30.0,
@@ -975,6 +1002,10 @@ def serve_http_main(argv) -> int:
         registry=args.registry,
         swap_to=args.swap_to,
         swap_at=args.swap_at,
+        canary_fraction=args.canary_fraction,
+        canary_replicas=args.canary_replicas,
+        shadow_every=args.shadow_every,
+        canary_thresholds=tuple(args.canary_thresholds),
         replica_queue_batches=args.replica_queue_batches,
         wedge_timeout_s=args.wedge_timeout_s,
         packed_weights=args.packed_weights,
@@ -1012,7 +1043,35 @@ def serve_http_main(argv) -> int:
         )
         return 1
     swap = result["verdict"].get("swap")
-    if swap is not None and (
+    canary = result["verdict"].get("canary")
+    if swap is not None and swap.get("state") == "rolled_back":
+        # a canary AUTO-ROLLBACK is the system working, not a failed
+        # rollout: vN kept serving, the registry is untouched, and the
+        # episode's evidence is in the verdict. Sheds inside the
+        # rollout window were caused by the DEGRADED CANARY the
+        # rollback just removed — bounded by --canary-fraction, which
+        # is the whole point — so they are reported loudly here but do
+        # not flip the exit code the way a COMPLETED swap's sheds do.
+        # `compare` is where a rollback becomes a CI regression: the
+        # serve_canary_rollbacks gate is zero-tolerance, and
+        # serve_swap_dropped already scores any not-performed rollout
+        # (this one included) as at least one lost unit.
+        shed = swap.get("shed") or 0
+        print(
+            f"[serve-http] canary to {swap.get('version_to')} "
+            f"ROLLED BACK (trigger "
+            f"{(canary or {}).get('trigger')}) — "
+            f"{swap.get('version_from')} kept serving, registry "
+            "untouched"
+            + (
+                f"; {shed} request(s) shed inside the canary window "
+                "(the degraded canary's doing — see the verdict's "
+                "canary block)"
+                if shed else ""
+            ),
+            file=sys.stderr,
+        )
+    elif swap is not None and (
         not swap.get("performed") or (swap.get("shed") or 0) > 0
     ):
         # the zero-downtime contract: a rollout that failed, or that

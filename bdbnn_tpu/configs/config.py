@@ -431,6 +431,23 @@ class ServeHttpConfig:
     # scenario the swap can be driven externally via POST /admin/swap.
     swap_to: str = ""
     swap_at: float = 0.0
+    # canary stage (serve/canary.py): > 0 turns every triggered
+    # rollout (--swap-at scheduled or POST /admin/swap) into a canary
+    # rollout — this traffic fraction routes (deterministic seeded
+    # assignment) to vN+1 on `canary_replicas` replicas while the
+    # CanaryMonitor compares live per-priority p99 / shed / fairness /
+    # queue-share / logit-drift windows against the incumbent's and
+    # auto-promotes or auto-rolls-back. 0 = the classic unconditional
+    # blue/green shift.
+    canary_fraction: float = 0.0
+    canary_replicas: int = 1
+    # shadow mirroring: every Nth incumbent-assigned batch is ALSO
+    # executed on the canary and the logits diffed off the hot path —
+    # exact, because packed inference is deterministic. 0 = off.
+    shadow_every: int = 8
+    # "NAME=VALUE" overrides of serve/canary.py CanaryConfig fields
+    # (thresholds + observation-loop knobs), validated at config time
+    canary_thresholds: Tuple[str, ...] = ()
     replica_queue_batches: int = 8
     wedge_timeout_s: float = 30.0
     # weight residency (nn/packed.py): keep binary convs 1-bit in
@@ -581,6 +598,41 @@ class ServeHttpConfig:
                 "every batch assembled during the shift would shed, "
                 "failing the zero-shed gate by construction"
             )
+        if not 0.0 <= self.canary_fraction < 1.0:
+            raise ValueError(
+                "--canary-fraction is the traffic fraction routed to "
+                f"the canary, in [0, 1), got {self.canary_fraction!r}"
+            )
+        if self.canary_fraction > 0:
+            if self.replicas < 2:
+                raise ValueError(
+                    "--canary-fraction needs --replicas >= 2: the "
+                    "canary subset serves vN+1 while at least one "
+                    "incumbent replica keeps serving vN — with one "
+                    "replica there is no incumbent cohort to compare "
+                    "against (or to roll back to under load)"
+                )
+            if not 1 <= self.canary_replicas <= self.replicas - 1:
+                raise ValueError(
+                    f"--canary-replicas must be in [1, replicas-1] = "
+                    f"[1, {self.replicas - 1}], got "
+                    f"{self.canary_replicas!r}: the canary subset must "
+                    "leave at least one incumbent replica serving vN"
+                )
+        if self.shadow_every < 0:
+            raise ValueError(
+                "--shadow-every must be >= 0 (0 disables the "
+                "logit-drift probe)"
+            )
+        if self.canary_thresholds:
+            # unknown detector-threshold names fail at config time,
+            # not mid-rollout (the --health-threshold precedent)
+            from bdbnn_tpu.serve.canary import (
+                CanaryConfig,
+                apply_canary_overrides,
+            )
+
+            apply_canary_overrides(CanaryConfig(), self.canary_thresholds)
         if self.replica_queue_batches <= 0:
             raise ValueError("--replica-queue-batches must be >= 1")
         if self.wedge_timeout_s <= 0:

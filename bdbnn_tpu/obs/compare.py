@@ -114,6 +114,20 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("serve_p99_queue_ms", "lower", "rel"),
     ("serve_p99_compute_ms", "lower", "rel"),
     ("serve_queue_share", "lower", "rel"),
+    # v5 canary rollouts (serve/canary.py): a canary that ROLLED BACK
+    # is a regression no tolerance can wave through — the whole point
+    # of the doctored-run gate is that the rollback is visible even
+    # when the aggregate p99 is unchanged (the degradation hit only a
+    # priority-class window). Shadow logit drift is likewise
+    # zero-tolerance: packed inference is deterministic and
+    # bitwise-exact, so ANY drift between an incumbent and a
+    # republished-identical canary is a real defect, never float
+    # noise. Promote wall seconds is an ordinary perf metric
+    # (--tol-rel). v1-v4 verdicts (no canary block) leave all three
+    # None (skipped).
+    ("serve_canary_rollbacks", "lower", "count"),
+    ("serve_shadow_logit_drift_max", "lower", "count"),
+    ("serve_canary_promote_s", "lower", "rel"),
 )
 
 # serve-verdict field -> compare metric name (flat v1 aggregates)
@@ -176,6 +190,19 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
         (stages.get("compute") or {}).get("p99_ms")
     )
     out["serve_queue_share"] = (att or {}).get("queue_share")
+    # v5 canary block (serve/canary.py): rollback count, the shadow
+    # probe's max-abs logit drift (None when no mirror ever compared —
+    # "not measured", never a fabricated 0.0), and the promote wall
+    # seconds (None on rollbacks). Absent block -> all None, so v1-v4
+    # verdicts skip cleanly.
+    can = verdict.get("canary")
+    out["serve_canary_rollbacks"] = (
+        None if can is None else int(can.get("rollbacks") or 0)
+    )
+    out["serve_shadow_logit_drift_max"] = (
+        (can or {}).get("shadow") or {}
+    ).get("max_abs_drift")
+    out["serve_canary_promote_s"] = (can or {}).get("promote_s")
     swap = verdict.get("swap")
     if swap is None:
         out["serve_swap_dropped"] = None

@@ -110,11 +110,36 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
 - ``swap``        — blue/green artifact rollout (serve/pool.py),
   disambiguated by ``phase``: ``trigger`` (the swap-under-load
   orchestration fired at a schedule position), ``start``
-  (version_from/version_to, replica count), ``warm`` (one standby
-  runner built + AOT-warmed, per replica), ``shift`` (one replica
-  drained its vN work and now serves vN+1), ``done`` (rollout
-  complete: seconds, replicas shifted), ``failed`` (the standby build
-  aborted — vN kept serving; error recorded)
+  (version_from/version_to, replica count; ``canary`` true when the
+  rollout runs the canary stage), ``warm`` (one standby runner built +
+  AOT-warmed, per replica), ``shift`` (one replica drained its vN work
+  and now serves vN+1; ``canary`` true for the canary subset's
+  shifts), ``done`` (rollout complete: seconds, replicas shifted),
+  ``failed`` (the standby build aborted — vN kept serving; error
+  recorded), ``rolled_back`` (the canary stage auto-rolled the rollout
+  back: trigger detector, seconds — vN kept serving BY DESIGN, not a
+  failure)
+- ``canary``      — one canary episode's lifecycle (serve/canary.py
+  via serve/pool.py), disambiguated by ``phase``: ``start`` (fraction,
+  versions, the canary replica subset, shadow sampling), ``observing``
+  (the subset shifted; the observation loop begins: eval interval +
+  budget), ``evaluate`` (one monitor tick: the per-detector evidence
+  table — value/threshold/breach/fired/eligible per detector — plus
+  cohort served counts and the running decision), ``decision`` (the
+  episode resolved outside a normal evaluate — budget timeout:
+  decision, trigger, reason), ``rollback`` (one canary replica drained
+  its vN+1 work and restored vN: which runner — rebuilt via the
+  factory or the retained original), ``promote`` (the canary passed;
+  the full replica-by-replica shift completed: seconds, evaluations).
+  The whole episode also lands as the v5 SLO verdict's nullable
+  ``canary`` block, which ``compare`` judges
+- ``shadow``      — the shadow-mirroring logit-drift probe
+  (serve/pool.py comparator thread), disambiguated by ``phase``:
+  ``mirror`` (one sampled incumbent batch was ALSO executed on the
+  canary and the logits diffed off the hot path: batch seq, versions,
+  ``drift`` = max abs element-wise difference — EXACTLY 0.0 between
+  identical artifacts because packed inference is deterministic and
+  bitwise-exact; any nonzero drift is a real defect)
 - ``rtrace``      — request-path lifecycle tracing (obs/rtrace.py),
   disambiguated by ``phase``: ``request`` (one SAMPLED request's full
   waterfall — seq, priority, tenant, total_ms, per-stage ms over the
@@ -180,6 +205,8 @@ KNOWN_KINDS = frozenset(
         "replica",
         "swap",
         "rtrace",
+        "canary",
+        "shadow",
     }
 )
 
@@ -349,7 +376,21 @@ def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     replicas = [e for e in events if e.get("kind") == "replica"]
     swaps = [e for e in events if e.get("kind") == "swap"]
     rtraces = [e for e in events if e.get("kind") == "rtrace"]
+    canaries = [e for e in events if e.get("kind") == "canary"]
+    shadows = [e for e in events if e.get("kind") == "shadow"]
     return {
+        "canary_events": canaries,
+        "canary_last": canaries[-1] if canaries else None,
+        "canary_last_evaluate": next(
+            (
+                e for e in reversed(canaries)
+                if e.get("phase") == "evaluate"
+            ),
+            None,
+        ),
+        "shadow_mirrors": [
+            e for e in shadows if e.get("phase") == "mirror"
+        ],
         "rtrace_stats": next(
             (
                 e for e in reversed(rtraces)

@@ -160,7 +160,13 @@ def _finite_mean(d: Optional[Dict[str, Any]]) -> Optional[float]:
 class _DetectorState:
     """The warmup + debounce + hysteresis state machine one detector
     runs per drain. ``update`` returns True exactly when an alert
-    should fire."""
+    should fire.
+
+    Exported as :data:`DetectorState`: the serving-side canary monitor
+    (serve/canary.py) runs the SAME discipline over live request
+    windows — one state machine, two consumers, so the semantics of
+    "a breach must persist, then latch" can never drift between the
+    training and serving health stacks."""
 
     __slots__ = ("warmup", "debounce", "seen", "streak", "latched", "fired")
 
@@ -191,6 +197,10 @@ class _DetectorState:
         self.latched = True
         self.streak = 0
         return True
+
+
+# the public name serving (serve/canary.py) builds its detectors on
+DetectorState = _DetectorState
 
 
 class HealthMonitor:
@@ -436,6 +446,7 @@ __all__ = [
     "DETECTORS",
     "RUN_ENDING_SEVERITY",
     "SEVERITIES",
+    "DetectorState",
     "HealthConfig",
     "HealthMonitor",
     "apply_overrides",

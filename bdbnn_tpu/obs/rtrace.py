@@ -135,6 +135,29 @@ def pop_future_timing(fut: Any) -> Optional[tuple]:
     return timing
 
 
+def set_future_answered_by(fut: Any, version: str) -> None:
+    """Attach the artifact version that ANSWERED a future — the replica
+    worker labels its batch Future before resolving it, the batcher
+    relabels each per-request future at settle, and the HTTP front end
+    reads it to feed the canary monitor's per-cohort latency windows
+    (serve/canary.py). Same private-attribute channel as the timing
+    split: thread-safe because it is written strictly before
+    ``set_result`` and read strictly after the wait returns."""
+    fut._rtrace_answered_by = str(version)
+
+
+def pop_future_answered_by(fut: Any) -> Optional[str]:
+    """The version label attached by :func:`set_future_answered_by`,
+    or None (single-engine paths, pre-canary pools)."""
+    version = getattr(fut, "_rtrace_answered_by", None)
+    if version is not None:
+        try:
+            del fut._rtrace_answered_by
+        except AttributeError:
+            pass
+    return version
+
+
 class RequestTrace:
     """One request's append-only stage stamps.
 
@@ -551,6 +574,8 @@ __all__ = [
     "STAGES",
     "RequestTrace",
     "RequestTracer",
+    "pop_future_answered_by",
     "pop_future_timing",
+    "set_future_answered_by",
     "set_future_timing",
 ]

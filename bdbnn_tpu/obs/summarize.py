@@ -343,12 +343,15 @@ def _serving(events) -> Optional[Dict[str, Any]]:
                           "max_queue", "preempted", "drained_clean",
                           "wall_s", "scenario", "per_priority",
                           "per_tenant", "fairness_ratio", "slo",
-                          "replicas", "scaling", "swap", "attribution")
+                          "replicas", "scaling", "swap", "attribution",
+                          "canary")
             }
             if verdict
             else None
         ),
         "replica_restarts": len(digest["replica_restarts"]),
+        "canary_events": len(digest["canary_events"]),
+        "shadow_mirrors": len(digest["shadow_mirrors"]),
     }
 
 
@@ -684,6 +687,67 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                             f"{v}: {n}" for v, n in sorted(by.items())
                         )
                     )
+            # the v5 canary episode: decision + trigger, the
+            # observation windows, the per-detector evidence table and
+            # the shadow-probe accounting — the rollout's whole story
+            # reconstructable from the run dir alone
+            can = sv.get("canary")
+            if can:
+                decision = can.get("decision")
+                lines.append(
+                    f"  canary: {can.get('version_from')} -> "
+                    f"{can.get('version_to')} | fraction "
+                    f"{can.get('fraction')} on replicas "
+                    f"{can.get('replicas_canary')} | "
+                    + (
+                        f"ROLLED BACK (trigger {can.get('trigger')})"
+                        if decision == "rollback"
+                        else f"PROMOTED in {can.get('promote_s')}s"
+                        if decision == "promote"
+                        else str(decision)
+                    )
+                    + f" after {can.get('evaluations')} evaluation(s)"
+                    f" over {can.get('observe_s')}s"
+                )
+                served = can.get("served") or {}
+                lines.append(
+                    "    served: canary "
+                    f"{served.get('canary')} / incumbent "
+                    f"{served.get('incumbent')}"
+                )
+                dets = can.get("detectors") or {}
+                if dets:
+                    lines.append(
+                        f"    {'detector':<14} {'value':>10} "
+                        f"{'threshold':>10} {'status':>10}"
+                    )
+                    for name in sorted(dets):
+                        d = dets[name] or {}
+                        status = (
+                            "FIRED" if d.get("fired")
+                            else "breach" if d.get("breach")
+                            else "ok" if d.get("eligible")
+                            else "no data"
+                        )
+                        val = d.get("value")
+                        thr = d.get("threshold")
+                        lines.append(
+                            f"    {name:<14} "
+                            f"{'-' if val is None else format(val, '.4g'):>10} "
+                            f"{'-' if thr is None else format(thr, '.4g'):>10} "
+                            f"{status:>10}"
+                        )
+                shadow = can.get("shadow") or {}
+                lines.append(
+                    f"    shadow: {shadow.get('mirrored')} mirrored, "
+                    f"{shadow.get('compared')} compared, max drift "
+                    f"{shadow.get('max_abs_drift')}"
+                    + (
+                        " (bitwise-exact — any nonzero drift is a "
+                        "real defect)"
+                        if (shadow.get('compared') or 0) > 0 else ""
+                    )
+                )
             # the v4 request-path attribution: per-priority p99
             # decomposed by lifecycle stage, the reconciliation
             # identity, and the slowest exemplars' waterfalls

@@ -149,6 +149,65 @@ def _serve_lines(events) -> List[str]:
                 f"!! swap to {swap_last.get('version_to')} FAILED "
                 f"({swap_last.get('error')}) — old version kept serving"
             )
+        elif phase == "rolled_back":
+            lines.append(
+                f"!! CANARY ROLLBACK: {swap_last.get('version_to')} "
+                f"rejected (trigger {swap_last.get('trigger')}) in "
+                f"{swap_last.get('seconds')}s — "
+                f"{swap_last.get('version_from')} kept serving, "
+                "registry untouched"
+            )
+    canary_last = digest["canary_last"]
+    canary_eval = digest["canary_last_evaluate"]
+    if canary_last and verdict is None:
+        phase = canary_last.get("phase")
+        if phase in ("start", "observing", "evaluate", "decision"):
+            # the live canary banner: fraction + windows from the
+            # newest evaluate tick, one status mark per detector
+            ev = canary_eval or {}
+            dets = ev.get("detectors") or {}
+            marks = []
+            for name in sorted(dets):
+                d = dets[name] or {}
+                if d.get("fired"):
+                    marks.append(f"{name}:FIRED")
+                elif d.get("breach"):
+                    marks.append(f"{name}:breach")
+                elif not d.get("eligible"):
+                    marks.append(f"{name}:warming")
+                else:
+                    marks.append(f"{name}:ok")
+            start = next(
+                (
+                    e for e in digest["canary_events"]
+                    if e.get("phase") == "start"
+                ),
+                {},
+            )
+            lines.append(
+                f">> CANARY {start.get('version_from')} -> "
+                f"{start.get('version_to')}: observing | fraction "
+                f"{start.get('fraction')} | replicas "
+                f"{start.get('replicas_canary')} | eval "
+                f"#{ev.get('evaluation', 0)} | served canary "
+                f"{ev.get('canary_served', 0)} / incumbent "
+                f"{ev.get('incumbent_served', 0)}"
+            )
+            if marks:
+                lines.append("   detectors: " + "  ".join(marks))
+        elif phase == "rollback":
+            lines.append(
+                f"!! CANARY ROLLBACK in progress: replica "
+                f"{canary_last.get('replica')} restoring "
+                f"{canary_last.get('version_restored')}"
+            )
+        elif phase == "promote":
+            lines.append(
+                f"canary: {canary_last.get('version_from')} -> "
+                f"{canary_last.get('version_to')} PROMOTED in "
+                f"{canary_last.get('seconds')}s "
+                f"({canary_last.get('evaluations')} evaluations)"
+            )
     if http_stats and verdict is None:
         s = http_stats[-1]
         age = time.time() - float(s.get("t", time.time()))
@@ -263,6 +322,35 @@ def _serve_lines(events) -> List[str]:
                     )
                 )
             )
+        can = verdict.get("canary")
+        if can:
+            decision = can.get("decision")
+            shadow = can.get("shadow") or {}
+            lines.append(
+                f"  canary: fraction {can.get('fraction')} | "
+                + (
+                    f"ROLLED BACK (trigger {can.get('trigger')})"
+                    if decision == "rollback"
+                    else f"promoted in {can.get('promote_s')}s"
+                    if decision == "promote"
+                    else str(decision)
+                )
+                + f" after {can.get('evaluations')} evaluation(s) | "
+                f"shadow drift "
+                f"{shadow.get('max_abs_drift')} over "
+                f"{shadow.get('compared')} mirror(s)"
+            )
+            fired = [
+                name
+                for name, d in sorted(
+                    (can.get("detectors") or {}).items()
+                )
+                if (d or {}).get("fired")
+            ]
+            if fired:
+                lines.append(
+                    "    fired detectors: " + ", ".join(fired)
+                )
         att = verdict.get("attribution")
         if att:
             # the final waterfall: where the p99 went, stage by stage,

@@ -36,6 +36,12 @@ Training's other half. Four modules, composing bottom-up:
   immutable published versions with a verified digest chain
   (index → artifact.json → weights.npz) + provenance, the store swap
   targets resolve from
+- :mod:`bdbnn_tpu.serve.canary`   — self-driving rollouts: the canary
+  stage's live-verdict monitor (warmup→debounce→hysteresis detectors
+  over per-cohort request windows, obs/health.py discipline) whose
+  decision auto-promotes or auto-rolls-back a staged rollout, plus
+  the exact shadow logit-drift probe packed determinism makes free
+  (stdlib-only)
 
 CLI surface: ``export`` / ``predict`` / ``serve-bench`` /
 ``serve-http`` (``bdbnn_tpu.cli``). Import of this package root stays
@@ -47,6 +53,11 @@ from __future__ import annotations
 
 from bdbnn_tpu.serve.admission import AdmissionController, TokenBucket
 from bdbnn_tpu.serve.batching import LoadShedError, MicroBatcher
+from bdbnn_tpu.serve.canary import (
+    CanaryConfig,
+    CanaryMonitor,
+    apply_canary_overrides,
+)
 from bdbnn_tpu.serve.export import (
     ARTIFACT_NAME,
     WEIGHTS_NAME,
@@ -80,6 +91,8 @@ __all__ = [
     "WEIGHTS_NAME",
     "AdmissionController",
     "ArtifactRegistry",
+    "CanaryConfig",
+    "CanaryMonitor",
     "HttpFrontEnd",
     "HttpLoadGenerator",
     "LoadGenerator",
@@ -89,6 +102,7 @@ __all__ = [
     "Replica",
     "ReplicaPool",
     "TokenBucket",
+    "apply_canary_overrides",
     "build_schedule",
     "make_engine_runner_factory",
     "export_artifact",

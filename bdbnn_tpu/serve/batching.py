@@ -39,7 +39,11 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
 
-from bdbnn_tpu.obs.rtrace import pop_future_timing
+from bdbnn_tpu.obs.rtrace import (
+    pop_future_answered_by,
+    pop_future_timing,
+    set_future_answered_by,
+)
 
 
 class LoadShedError(RuntimeError):
@@ -373,6 +377,7 @@ class MicroBatcher:
                             self._settle(
                                 batch, f.result(), t0, time.monotonic(),
                                 timing=pop_future_timing(f),
+                                answered_by=pop_future_answered_by(f),
                             )
                     finally:
                         with self._cv:
@@ -384,14 +389,18 @@ class MicroBatcher:
             self._settle(batch, results, t0, time.monotonic())
 
     def _settle(
-        self, batch, results, t0: float, t1: float, timing=None
+        self, batch, results, t0: float, t1: float, timing=None,
+        answered_by=None,
     ) -> None:
         """Distribute one executed batch's results and account it —
         shared by the synchronous runner path and the async-dispatch
         callback. ``timing`` is the replica pool's measured
         (dispatch_ms, compute_ms) split riding the batch Future
         (obs/rtrace.py); the sync path has no dispatch hop, so the
-        whole runner wall is the compute stage."""
+        whole runner wall is the compute stage. ``answered_by`` (the
+        version label the replica worker attached) is relabeled onto
+        every per-request future so the front end can attribute each
+        request to the cohort that ANSWERED it (serve/canary.py)."""
         # stage accounting BEFORE the futures resolve: a waiter waking
         # on set_result must observe a fully-stamped trace
         for r in batch:
@@ -411,6 +420,10 @@ class MicroBatcher:
             # kill the worker thread for good
             try:
                 if not r.future.done():
+                    if answered_by is not None:
+                        # before set_result, so the waiter always
+                        # observes the label (the timing-split rule)
+                        set_future_answered_by(r.future, answered_by)
                     r.future.set_result(results[i])
             except Exception as e:
                 if not r.future.done():

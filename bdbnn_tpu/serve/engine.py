@@ -309,6 +309,43 @@ class InferenceEngine:
         return np.argmax(self.predict_logits(images), axis=-1)
 
 
+def max_abs_logit_drift(a, b) -> Optional[float]:
+    """Max absolute element-wise difference between two engines'
+    results for the SAME payloads — the shadow-mirroring probe's
+    comparator (serve/canary.py).
+
+    This number is meaningful as a zero-tolerance quality gate only
+    because packed 1-bit inference is deterministic and bitwise-exact:
+    the exported artifact is a fixed point of the training binarizer
+    (serve/export.py), the on-device unpack reproduces the host packing
+    bit-for-bit (nn/packed.py), and the engine's AOT-compiled buckets
+    run the identical subgraph on every call. Two engines serving the
+    same artifact therefore return BITWISE-identical logits — any
+    nonzero drift between an incumbent and a republished-identical
+    canary is a real defect (torn publish, wrong artifact, silent
+    dtype change, a degraded runner), never float noise. Float-serving
+    stacks cannot gate this cheaply; a 1-bit stack gets it for free.
+
+    ``a``/``b`` are whatever the replica runner returned (a stacked
+    logits array or a list of per-payload rows). Returns None when the
+    shapes cannot be aligned — an incomparable pair must be surfaced
+    as "no measurement", never as drift 0.0."""
+    try:
+        ra = [np.asarray(x, np.float64) for x in list(a)]
+        rb = [np.asarray(x, np.float64) for x in list(b)]
+        if len(ra) != len(rb) or any(
+            xa.shape != xb.shape for xa, xb in zip(ra, rb)
+        ):
+            return None
+        if not ra:
+            return 0.0
+        return float(
+            max(float(np.max(np.abs(xa - xb))) for xa, xb in zip(ra, rb))
+        )
+    except Exception:
+        return None
+
+
 def evaluate_split(engine: InferenceEngine, pipe) -> Dict[str, Any]:
     """Offline batch inference over a pipeline's split: top-1 over every
     example, computed with the same ``100 * correct / count`` arithmetic
@@ -332,4 +369,9 @@ def evaluate_split(engine: InferenceEngine, pipe) -> Dict[str, Any]:
     }
 
 
-__all__ = ["DEFAULT_BUCKETS", "InferenceEngine", "evaluate_split"]
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "InferenceEngine",
+    "evaluate_split",
+    "max_abs_logit_drift",
+]

@@ -59,6 +59,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from bdbnn_tpu.obs.events import jsonsafe
+from bdbnn_tpu.obs.rtrace import pop_future_answered_by
 from bdbnn_tpu.serve.admission import (
     ADMIT,
     DEFAULT_TENANT,
@@ -129,9 +130,16 @@ class HttpFrontEnd:
         admin: Optional[Any] = None,
         model_router: Optional[Callable[[str], str]] = None,
         tracer: Optional[Any] = None,
+        canary: Optional[Any] = None,
     ):
         self.batcher = batcher
         self.admission = admission
+        # canary monitor (serve/canary.py): when wired, every served
+        # request's (priority, latency, answered-by version) feeds the
+        # per-cohort latency windows the rollout verdict judges. The
+        # monitor ignores feeds outside an armed episode, so this
+        # costs one attribute read per request when no rollout runs.
+        self.canary = canary
         # request-lifecycle tracer (obs/rtrace.py): when wired, every
         # served request gets read/admit/queue/coalesce/dispatch/
         # compute/respond spans, /statsz exposes the live stage
@@ -660,6 +668,14 @@ class HttpFrontEnd:
         self._lat_by_priority[priority].append(lat_ms)
         counts["completed"] += 1
         self.admission.record_completed(tenant)
+        if self.canary is not None:
+            # cohort truth is who ANSWERED: the version label rides
+            # the request future (obs/rtrace.py), so a canary-assigned
+            # batch that fell back to the incumbent feeds the
+            # incumbent's window
+            self.canary.record_served(
+                priority, lat_ms, pop_future_answered_by(fut)
+            )
         if self.model_router is not None:
             # keyed by pool.DEFAULT_MODEL so resident_block can merge
             # this ledger into the cache-stats rows it keys the same
@@ -715,6 +731,11 @@ class HttpFrontEnd:
             "rtrace": (
                 self.tracer.stats() if self.tracer is not None else None
             ),
+            # the live canary view while a rollout observes: per-
+            # detector status, cohort served counts, drift so far
+            "canary": (
+                self.canary.live() if self.canary is not None else None
+            ),
         })
 
     def accounting(self) -> Dict[str, Any]:
@@ -742,10 +763,16 @@ class HttpFrontEnd:
 # ---------------------------------------------------------------------------
 
 
-def run_serve_http(cfg) -> Dict[str, Any]:
+def run_serve_http(cfg, degrade=None) -> Dict[str, Any]:
     """End-to-end HTTP serving over an export artifact (the
     ``serve-http`` CLI body). ``cfg`` is a
-    :class:`bdbnn_tpu.configs.config.ServeHttpConfig`.
+    :class:`bdbnn_tpu.configs.config.ServeHttpConfig`. ``degrade``
+    (tests and canary drills only — never a CLI flag) is the
+    fault-injection spec threaded into the pool's runner factory
+    (serve/pool.py ``_apply_degradation``): injectable per-version
+    latency inflation, error rate, or logit perturbation, so the
+    auto-rollback path can be proven against a genuinely degraded
+    vN+1 through the REAL orchestration.
 
     Two modes sharing one server lifecycle:
 
@@ -767,10 +794,10 @@ def run_serve_http(cfg) -> Dict[str, Any]:
     # multi-second AOT warmup must drain-and-report, not die with the
     # default disposition
     with PreemptionHandler() as handler:
-        return _serve_http_body(cfg, handler)
+        return _serve_http_body(cfg, handler, degrade)
 
 
-def _serve_http_body(cfg, handler) -> Dict[str, Any]:
+def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
     import datetime
 
     import numpy as np
@@ -860,6 +887,13 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             else None,
             "swap_to": cfg.swap_to or None,
             "swap_at": cfg.swap_at or None,
+            "canary_fraction": cfg.canary_fraction or None,
+            "canary_replicas": (
+                cfg.canary_replicas if cfg.canary_fraction else None
+            ),
+            "shadow_every": (
+                cfg.shadow_every if cfg.canary_fraction else None
+            ),
             "packed_weights": cfg.packed_weights,
             "packed_impl": cfg.packed_impl,
             "resident_models": cfg.resident_models,
@@ -1055,6 +1089,24 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
                     f"{e.args[0] if e.args else e}"
                 )
 
+    # the canary monitor (serve/canary.py): one long-lived instance,
+    # armed per rollout episode by the pool — the front end feeds it
+    # served latencies, the replica workers feed it batch splits, and
+    # its live verdict decides promote vs auto-rollback
+    canary_monitor = None
+    if cfg.canary_fraction > 0:
+        from bdbnn_tpu.serve.canary import (
+            CanaryConfig,
+            CanaryMonitor,
+            apply_canary_overrides,
+        )
+
+        canary_monitor = CanaryMonitor(
+            apply_canary_overrides(CanaryConfig(), cfg.canary_thresholds),
+            priorities=cfg.priorities,
+            on_event=lambda kind, **f: events.emit(kind, **f),
+        )
+
     front = HttpFrontEnd(
         batcher,
         admission,
@@ -1066,6 +1118,7 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         max_body_bytes=int(cfg.max_body_mb * 2**20),
         model_router=model_router,
         tracer=tracer,
+        canary=canary_monitor,
     )
     host, port = front.start()
     events.emit(
@@ -1108,6 +1161,7 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             resident_models=cfg.resident_models,
             model_dirs=model_dirs,
             on_event=lambda kind, **f: events.emit(kind, **f),
+            degrade=degrade,
         )
         pool = ReplicaPool(
             factory,
@@ -1139,6 +1193,19 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
             # counts shed batches, a different unit)
             shed_counter=lambda: (
                 batcher.stats()["shed"] + pool.stats()["shed_requests"]
+            ),
+            # --canary-fraction > 0 turns every triggered rollout into
+            # a canary rollout: the monitor's live verdict promotes or
+            # auto-rolls-back instead of an unconditional full shift
+            canary=(
+                {
+                    "monitor": canary_monitor,
+                    "fraction": cfg.canary_fraction,
+                    "replicas": cfg.canary_replicas,
+                    "shadow_every": cfg.shadow_every,
+                    "seed": cfg.seed,
+                }
+                if canary_monitor is not None else None
             ),
         )
         front.admin = admin
@@ -1412,6 +1479,9 @@ def _serve_http_body(cfg, handler) -> Dict[str, Any]:
         packed=packed_block,
         attribution=(
             tracer.attribution() if tracer is not None else None
+        ),
+        canary=(
+            admin.canary_report() if admin is not None else None
         ),
     )
     events.emit("serve", phase="verdict", **verdict)

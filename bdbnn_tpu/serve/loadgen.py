@@ -76,7 +76,13 @@ VERDICT_NAME = "verdict.json"
 # reconciliation identity, the slowest-K tail-exemplar waterfalls per
 # priority and the two-clock documentation. Null when tracing is off,
 # so v1-v3 consumers keep working unchanged.
-VERDICT_SCHEMA_VERSION = 4
+# v5: the ``canary`` block (serve/canary.py) — one canary episode's
+# full evidence: fraction, cohort identity, per-detector
+# value/threshold/fired table, decision + trigger, rollback count,
+# shadow-mirroring accounting with the max-abs logit drift, and the
+# promote wall seconds. Null when no canary stage ran, so v1-v4
+# consumers keep working unchanged.
+VERDICT_SCHEMA_VERSION = 5
 
 
 def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -684,6 +690,7 @@ def slo_verdict(
     resident: Optional[Dict[str, Any]] = None,
     packed: Optional[Dict[str, Any]] = None,
     attribution: Optional[Dict[str, Any]] = None,
+    canary: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble the deterministic strict-JSON SLO verdict.
 
@@ -709,7 +716,12 @@ def slo_verdict(
     p50/p99 decomposed by lifecycle stage, the stage-sum-vs-e2e
     reconciliation identity and the tail-exemplar waterfalls — the
     block ``compare`` reads its stage-share metrics from. Null when
-    tracing is off."""
+    tracing is off. The canary stage (serve/canary.py) adds the v5
+    ``canary`` block: the rollout episode's evidence — decision,
+    trigger, per-detector table, shadow-drift accounting — the source
+    of ``compare``'s ``serve_canary_rollbacks`` /
+    ``serve_shadow_logit_drift_max`` / ``serve_canary_promote_s``
+    gates. Null when no canary stage ran."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
@@ -749,6 +761,7 @@ def slo_verdict(
         "resident": resident,
         "packed": packed,
         "attribution": attribution,
+        "canary": canary,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (
@@ -782,6 +795,7 @@ def http_slo_verdict(
     resident: Optional[Dict[str, Any]] = None,
     packed: Optional[Dict[str, Any]] = None,
     attribution: Optional[Dict[str, Any]] = None,
+    canary: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Build the v2 verdict from the HTTP front end's request ledger
     (:meth:`serve.http.HttpFrontEnd.accounting`), the batcher's
@@ -873,6 +887,7 @@ def http_slo_verdict(
         resident=resident,
         packed=packed,
         attribution=attribution,
+        canary=canary,
     )
 
 

@@ -31,7 +31,12 @@ batches execute on N devices concurrently. Four responsibilities:
    accepted work finishes on vN, its runner pointer swaps, it rejoins
    serving vN+1) while the rest of the pool absorbs the load. Every
    request is answered by exactly one version; the pool ledger records
-   which (``completed_by_version``).
+   which (``completed_by_version``). :meth:`canary_swap` extends the
+   same machine with a CANARY stage (serve/canary.py): a seeded
+   traffic fraction routes to vN+1 on a replica subset, sampled
+   incumbent batches mirror onto it for an exact logit-drift probe,
+   and the live-verdict monitor decides — promote into the full shift
+   above, or auto-rollback (vN restored, registry untouched).
 4. **Drain** — the PR 5/7 latched-flag contract one layer down: after
    :meth:`drain` no batch enters a replica queue, every queued batch is
    executed and answered, then workers exit.
@@ -52,7 +57,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from bdbnn_tpu.obs.rtrace import set_future_timing
+from bdbnn_tpu.obs.rtrace import set_future_answered_by, set_future_timing
 from bdbnn_tpu.serve.batching import LoadShedError
 
 # replica states: dispatchable is READY only
@@ -68,12 +73,20 @@ SWAP_WARMING = "warming"
 SWAP_SHIFTING = "shifting"
 SWAP_DONE = "done"
 SWAP_FAILED = "failed"
+# the canary stage's additions (serve/canary.py): a rollout may now
+# pause in an observation state and resolve to a rollback — a terminal
+# state that is NOT a failure (vN kept serving by design, registry
+# untouched)
+SWAP_CANARY_WARMING = "canary_warming"
+SWAP_CANARY = "canary"
+SWAP_ROLLING_BACK = "rolling_back"
+SWAP_ROLLED_BACK = "rolled_back"
 
 
 class _Work:
-    __slots__ = ("payloads", "future", "t_enqueue")
+    __slots__ = ("payloads", "future", "t_enqueue", "shadow")
 
-    def __init__(self, payloads):
+    def __init__(self, payloads, shadow: bool = False):
         self.payloads = payloads
         self.future: Future = Future()
         # perf_counter, matching the request tracer's clock: the
@@ -81,6 +94,11 @@ class _Work:
         # on the batch Future (obs/rtrace.py) and must never mix clock
         # bases with the batcher's stamps
         self.t_enqueue = time.perf_counter()
+        # a shadow-mirror duplicate (serve/canary.py): executed for the
+        # logit-drift probe only — excluded from every serving ledger
+        # (batches/completed/answered_by), or the verdict's identity
+        # "answered_by sums to requests_completed" would double-count
+        self.shadow = shadow
 
 
 class Replica:
@@ -106,6 +124,12 @@ class Replica:
         self._cv = threading.Condition(self._lock)
         self._q: deque = deque()
         self.state = READY
+        # canary cohort membership (serve/canary.py): while a canary
+        # stage is active the dispatcher routes the canary traffic
+        # fraction to replicas with this flag set; a health restart
+        # preserves it (the runner — and therefore the version — is
+        # unchanged by a restart)
+        self.canary = False
         # monotonic timestamp of the batch currently executing (None =
         # idle) — the wedge detector's heartbeat
         self.busy_since: Optional[float] = None
@@ -124,6 +148,14 @@ class Replica:
         # unresolved
         self._retired_threads: List[threading.Thread] = []
         self._on_done: Optional[Callable[["Replica", int, str], None]] = None
+        # canary-era hooks (both skip shadow work): _on_fail records
+        # engine failures per version for the error-rate detector;
+        # _on_batch feeds the measured dispatch/compute split to the
+        # queue-share detector (serve/canary.py)
+        self._on_fail: Optional[Callable[["Replica", int, str], None]] = None
+        self._on_batch: Optional[
+            Callable[[str, float, float], None]
+        ] = None
         self.start_worker()
 
     # -- worker --------------------------------------------------------
@@ -177,7 +209,13 @@ class Replica:
                         self.busy_since = None
                 if not work.future.done():
                     work.future.set_exception(e)
+                if self._on_fail is not None and not work.shadow:
+                    try:
+                        self._on_fail(self, len(work.payloads), version)
+                    except Exception:
+                        pass  # ledger hooks must never kill a worker
                 continue
+            compute_ms = (time.perf_counter() - t_pick) * 1000.0
             retired = False
             with self._cv:
                 if self._gen == gen:
@@ -187,20 +225,31 @@ class Replica:
                 # a retiring (superseded) worker's answered batch still
                 # counts: it WAS served by this replica, and the
                 # per-replica table must agree with the
-                # completed-by-version ledger _on_done feeds
-                self.batches += 1
-                self.completed += len(work.payloads)
+                # completed-by-version ledger _on_done feeds. Shadow
+                # duplicates count NOWHERE: they exist only for the
+                # logit-drift probe, and every serving ledger must see
+                # exactly the client's requests.
+                if not work.shadow:
+                    self.batches += 1
+                    self.completed += len(work.payloads)
             if not work.future.done():
-                set_future_timing(
-                    work.future, dispatch_ms,
-                    (time.perf_counter() - t_pick) * 1000.0,
-                )
+                set_future_timing(work.future, dispatch_ms, compute_ms)
+                # the version that ANSWERED rides the batch Future so
+                # the front end can attribute each request to its
+                # cohort (serve/canary.py) — labeled before set_result
+                set_future_answered_by(work.future, version)
                 work.future.set_result(results)
-            if self._on_done is not None:
-                try:
-                    self._on_done(self, len(work.payloads), version)
-                except Exception:
-                    pass  # ledger hooks must never kill a worker
+            if not work.shadow:
+                if self._on_done is not None:
+                    try:
+                        self._on_done(self, len(work.payloads), version)
+                    except Exception:
+                        pass  # ledger hooks must never kill a worker
+                if self._on_batch is not None:
+                    try:
+                        self._on_batch(version, dispatch_ms, compute_ms)
+                    except Exception:
+                        pass
             if retired:
                 return  # a wedged worker's last act: answer, then exit
 
@@ -276,6 +325,7 @@ class Replica:
                 "device": self.device,
                 "version": self.version,
                 "state": self.state,
+                "canary": self.canary,
                 "queue_depth": len(self._q),
                 "busy": self.busy_since is not None,
                 "batches": self.batches,
@@ -330,8 +380,24 @@ class ReplicaPool:
         self.shed_requests = 0
         self.dispatched = 0
         self.completed_by_version: Dict[str, int] = {}
+        self.failed_by_version: Dict[str, int] = {}
         self._swap_lock = threading.Lock()
         self._swap_status: Dict[str, Any] = {"state": SWAP_IDLE}
+        # canary stage (serve/canary.py): non-None while a canary is
+        # observing — {"seed", "fraction", "version_to", "monitor",
+        # "shadow_every"}; submit snapshots it once per batch (a plain
+        # attribute read — the non-canary dispatch path pays one `is
+        # None` check and nothing else)
+        self._canary: Optional[Dict[str, Any]] = None
+        self._canary_seq = 0
+        self._cohort_counts: Optional[Dict[str, Dict[str, int]]] = None
+        # shadow comparator: mirror pairs queue + the thread that diffs
+        # them OFF the hot path (a worker's done-callback only appends)
+        self._shadow_queue: deque = deque()
+        self._shadow_wake = threading.Event()
+        self._shadow_stop = threading.Event()
+        self._shadow_thread: Optional[threading.Thread] = None
+        self._shadow_stats = {"mirrored": 0, "skipped": 0, "failed": 0}
         # the factory needs the REAL device objects (jax.Device on the
         # engine path); replica snapshots carry only the string label
         self._device_objs: List[Any] = list(devices)
@@ -345,6 +411,7 @@ class ReplicaPool:
                 max_queue_batches=max_queue_batches,
             )
             r._on_done = self._record_done
+            r._on_fail = self._record_fail
             self.replicas.append(r)
             self._emit(
                 "replica", phase="start", replica=rid, device=str(dev),
@@ -370,6 +437,19 @@ class ReplicaPool:
                 self.completed_by_version.get(version, 0) + n
             )
 
+    def _record_fail(self, replica: Replica, n: int, version: str) -> None:
+        with self._lock:
+            self.failed_by_version[version] = (
+                self.failed_by_version.get(version, 0) + n
+            )
+            canary = self._canary
+            if canary is not None and self._cohort_counts is not None:
+                cohort = (
+                    "canary" if version == canary["version_to"]
+                    else "incumbent"
+                )
+                self._cohort_counts[cohort]["failed_requests"] += n
+
     # -- dispatch ------------------------------------------------------
 
     def _place(self, work: _Work) -> Optional[bool]:
@@ -377,7 +457,14 @@ class ReplicaPool:
         requeue path: least-loaded READY replica first, then the rest
         (a candidate can fill between the load read and the enqueue,
         so try in order). True = enqueued; False = every candidate
-        full; None = no READY replica at all."""
+        full; None = no READY replica at all.
+
+        During a canary stage the restart-requeue path still uses this
+        cohort-less placement on purpose: a requeued batch crossing
+        cohorts is answered under the version label of whoever runs it
+        (the answered-by future channel), so the monitor's windows stay
+        truthful either way — availability beats cohort purity for
+        work that was already accepted."""
         candidates = sorted(
             (r for r in self.replicas if r.state == READY),
             key=lambda r: r.load(),
@@ -387,19 +474,90 @@ class ReplicaPool:
                 return True
         return False if candidates else None
 
+    def _place_cohort(self, work: _Work, to_canary: bool):
+        """Cohort-constrained placement while a canary stage is
+        active. Returns ``(placed_tristate, fallback)`` with the same
+        tri-state as :meth:`_place`. A canary-assigned batch whose
+        cohort cannot absorb it FALLS BACK to the incumbent — clients
+        never pay for the canary machinery with a shed, the fallback
+        is counted (the ``unabsorbed`` detector's evidence), and the
+        request is truthfully attributed to the incumbent that
+        answered it. Incumbent-assigned batches never touch canary
+        replicas: the traffic fraction is the canary's blast-radius
+        bound, not a hint."""
+        primary = sorted(
+            (
+                r for r in self.replicas
+                if r.state == READY and r.canary == to_canary
+            ),
+            key=lambda r: r.load(),
+        )
+        for r in primary:
+            if r.try_enqueue(work):
+                return True, False
+        if to_canary:
+            secondary = sorted(
+                (
+                    r for r in self.replicas
+                    if r.state == READY and not r.canary
+                ),
+                key=lambda r: r.load(),
+            )
+            for r in secondary:
+                if r.try_enqueue(work):
+                    return True, True
+        any_ready = any(r.state == READY for r in self.replicas)
+        return (False if any_ready else None), False
+
     def submit(self, payloads: List[Any]) -> Future:
         """Place one coalesced batch on the least-loaded READY replica;
         returns the batch Future (one result list for the whole batch —
         exactly what the micro-batcher's async runner contract wants).
         Raises :class:`LoadShedError` when draining, when no replica is
-        healthy, or when every healthy replica's queue is full."""
+        healthy, or when every healthy replica's queue is full.
+
+        While a canary stage is active (serve/canary.py) the batch is
+        first ASSIGNED a cohort — deterministic seeded draw over the
+        batch sequence number, so the traffic split is reproducible —
+        then placed within it (:meth:`_place_cohort`), and a sampled
+        incumbent batch is additionally MIRRORED to the canary for the
+        logit-drift probe (:meth:`_mirror`)."""
         if self._draining.is_set():
             with self._lock:
                 self.shed += 1
                 self.shed_requests += len(payloads)
             raise LoadShedError("draining")
         work = _Work(payloads)
-        placed = self._place(work)
+        canary = self._canary
+        if canary is None:
+            placed = self._place(work)
+        else:
+            from bdbnn_tpu.serve.canary import assign_canary
+
+            with self._lock:
+                seq = self._canary_seq
+                self._canary_seq += 1
+            to_canary = assign_canary(
+                canary["seed"], seq, canary["fraction"]
+            )
+            placed, fallback = self._place_cohort(work, to_canary)
+            with self._lock:
+                counts = self._cohort_counts
+                if counts is not None:
+                    c = counts["canary" if to_canary else "incumbent"]
+                    c["assigned_batches"] += 1
+                    c["assigned_requests"] += len(payloads)
+                    if fallback:
+                        counts["canary"]["fallbacks"] += 1
+                    if not placed:
+                        c["sheds"] += 1
+            if (
+                placed
+                and not to_canary
+                and not fallback
+                and canary.get("shadow_every", 0) > 0
+            ):
+                self._maybe_mirror(canary, seq, work, payloads)
         if placed:
             with self._lock:
                 self.dispatched += 1
@@ -410,6 +568,109 @@ class ReplicaPool:
         raise LoadShedError(
             "queue full" if placed is False else "no healthy replica"
         )
+
+    # -- shadow mirroring (the logit-drift probe) ----------------------
+
+    def _maybe_mirror(
+        self, canary: Dict[str, Any], seq: int, work: _Work, payloads
+    ) -> None:
+        """Mirror a sampled incumbent batch onto a canary replica: the
+        incumbent's answer goes to the client (its future is the one
+        submit returned), the canary executes the SAME payloads as a
+        shadow duplicate, and the pair lands on the comparator queue —
+        the diff itself runs on the dedicated shadow thread, never a
+        replica worker's."""
+        from bdbnn_tpu.obs.rtrace import _splitmix64
+
+        if _splitmix64(
+            (int(canary["seed"]) + 0x5AD0) ^ int(seq)
+        ) % int(canary["shadow_every"]) != 0:
+            return
+        shadow = _Work(payloads, shadow=True)
+        cands = sorted(
+            (r for r in self.replicas if r.state == READY and r.canary),
+            key=lambda r: r.load(),
+        )
+        placed = False
+        for r in cands:
+            if r.try_enqueue(shadow):
+                placed = True
+                break
+        if not placed:
+            # a full canary is already visible to the unabsorbed
+            # detector; a skipped mirror is only a missed measurement
+            with self._lock:
+                self._shadow_stats["skipped"] += 1
+            return
+        with self._lock:
+            self._shadow_stats["mirrored"] += 1
+        armed: List[bool] = []
+
+        def _arm(_f, armed=armed, work=work, shadow=shadow, seq=seq):
+            if not (work.future.done() and shadow.future.done()):
+                return
+            with self._lock:
+                if armed:
+                    return  # both callbacks saw both done — once only
+                armed.append(True)
+            self._shadow_queue.append((seq, work.future, shadow.future))
+            self._shadow_wake.set()
+
+        work.future.add_done_callback(_arm)
+        shadow.future.add_done_callback(_arm)
+
+    def _start_shadow(self, monitor) -> None:
+        self._shadow_stats = {"mirrored": 0, "skipped": 0, "failed": 0}
+        self._shadow_queue.clear()
+        self._shadow_stop.clear()
+        self._shadow_thread = threading.Thread(
+            target=self._shadow_loop, args=(monitor,),
+            name="canary-shadow", daemon=True,
+        )
+        self._shadow_thread.start()
+
+    def _stop_shadow(self, timeout: float = 5.0) -> None:
+        if self._shadow_thread is None:
+            return
+        self._shadow_stop.set()
+        self._shadow_wake.set()
+        self._shadow_thread.join(timeout)
+        self._shadow_thread = None
+
+    def _shadow_loop(self, monitor) -> None:
+        """Drain mirror pairs and diff them — the one place logits are
+        compared, off every request path. Runs until stopped AND the
+        queue is empty, so in-flight mirrors at decision time still
+        land their measurement."""
+        from bdbnn_tpu.serve.engine import max_abs_logit_drift
+
+        while True:
+            try:
+                seq, primary, mirror = self._shadow_queue.popleft()
+            except IndexError:
+                if self._shadow_stop.is_set():
+                    return
+                self._shadow_wake.wait(0.05)
+                self._shadow_wake.clear()
+                continue
+            try:
+                a, b = primary.result(0), mirror.result(0)
+            except Exception:
+                # either side shed/failed: not a comparison
+                with self._lock:
+                    self._shadow_stats["failed"] += 1
+                continue
+            drift = max_abs_logit_drift(a, b)
+            if drift is None:
+                with self._lock:
+                    self._shadow_stats["failed"] += 1
+                continue
+            monitor.record_drift(drift)
+            self._emit(
+                "shadow", phase="mirror", seq=seq, drift=drift,
+                version_from=monitor.version_from,
+                version_to=monitor.version_to,
+            )
 
     # -- health --------------------------------------------------------
 
@@ -460,6 +721,19 @@ class ReplicaPool:
         # itself is answered by the retiring worker when it unsticks)
         requeued = shed = 0
         for work in r.take_queued():
+            if work.shadow:
+                # a queued shadow duplicate is only a probe measurement:
+                # it must neither count as shed (no client sent it — the
+                # zero-shed swap gate would misfire) nor requeue through
+                # the cohort-less _place (executing the mirror on an
+                # incumbent replica would record a vN-vs-vN diff as a
+                # genuine drift measurement). Fail its future so the
+                # comparator files the pair under `failed`, and move on.
+                if not work.future.done():
+                    work.future.set_exception(
+                        LoadShedError("no healthy replica")
+                    )
+                continue
             placed = self._place(work)
             if placed:
                 requeued += 1
@@ -502,6 +776,96 @@ class ReplicaPool:
         with self._lock:
             return dict(self._swap_status)
 
+    def _set_swap_status(self, status: Dict[str, Any]) -> None:
+        with self._lock:
+            self._swap_status = dict(status)
+
+    def _warm_standbys(
+        self,
+        replicas: Sequence[Replica],
+        new_artifact_ref: Any,
+        new_version: str,
+        status: Dict[str, Any],
+        *,
+        canary: bool = False,
+    ) -> List[Callable]:
+        """Build + AOT-warm one standby runner per replica BEFORE any
+        traffic shifts — a failing factory marks the rollout FAILED
+        with vN fully serving and re-raises (the PR 8 contract, shared
+        by the full swap and both canary phases)."""
+        try:
+            standby = []
+            for r in replicas:
+                t_w = time.monotonic()
+                standby.append(
+                    self.runner_factory(
+                        new_artifact_ref, self._device_objs[r.rid]
+                    )
+                )
+                self._emit(
+                    "swap", phase="warm", replica=r.rid,
+                    device=r.device, version_to=str(new_version),
+                    seconds=round(time.monotonic() - t_w, 3),
+                    canary=canary or None,
+                )
+            return standby
+        except Exception as e:
+            status.update(state=SWAP_FAILED, error=str(e))
+            self._set_swap_status(status)
+            self._emit(
+                "swap", phase="failed", version_to=str(new_version),
+                error=str(e),
+            )
+            raise
+
+    def _drain_and_swap(
+        self, r: Replica, runner: Callable, version: str, *,
+        canary: bool,
+    ) -> bool:
+        """THE runner-replacement protocol, shared by the shift path
+        and the canary rollback: leave the dispatch set, let accepted
+        work finish (bounded by the wedge timeout), swap the runner,
+        rejoin READY with the cohort flag. State writes go under the
+        replica's lock (the health monitor also writes state). Returns
+        the drain outcome, captured BEFORE the replica rejoins — after
+        READY, peers' batches land on it and "queue empty now" no
+        longer says anything about how the drain went."""
+        with r._lock:
+            r.state = SHIFTING
+        deadline = time.monotonic() + max(self.wedge_timeout_s, 1.0)
+        while not r.idle() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        drained_clean = r.idle()
+        r.swap_runner(runner, str(version))
+        with r._lock:
+            r.canary = canary
+            r.state = READY
+        return drained_clean
+
+    def _shift_one(
+        self,
+        r: Replica,
+        runner: Callable,
+        new_version: str,
+        status: Dict[str, Any],
+        *,
+        canary: bool = False,
+    ) -> None:
+        """Shift ONE replica onto ``runner`` (peers absorb the load
+        meanwhile), account it in ``status`` and emit the shift event.
+        ``canary`` marks the replica's cohort on rejoin."""
+        drained_clean = self._drain_and_swap(
+            r, runner, new_version, canary=canary
+        )
+        status["replicas_shifted"] = status.get("replicas_shifted", 0) + 1
+        self._set_swap_status(status)
+        self._emit(
+            "swap", phase="shift", replica=r.rid, device=r.device,
+            version_from=status.get("version_from"),
+            version_to=str(new_version),
+            drained_clean=drained_clean, canary=canary or None,
+        )
+
     def swap(
         self, new_artifact_ref: Any, new_version: str
     ) -> Dict[str, Any]:
@@ -532,38 +896,13 @@ class ReplicaPool:
             # 1. standby set: build + AOT-warm EVERY new runner before
             #    any traffic shifts — a failed load aborts with vN
             #    fully serving
-            try:
-                standby = []
-                for r in self.replicas:
-                    t_w = time.monotonic()
-                    standby.append(
-                        self.runner_factory(
-                            new_artifact_ref, self._device_objs[r.rid]
-                        )
-                    )
-                    self._emit(
-                        "swap", phase="warm", replica=r.rid,
-                        device=r.device, version_to=str(new_version),
-                        seconds=round(time.monotonic() - t_w, 3),
-                    )
-            except Exception as e:
-                status.update(state=SWAP_FAILED, error=str(e))
-                with self._lock:
-                    self._swap_status = dict(status)
-                self._emit(
-                    "swap", phase="failed", version_to=str(new_version),
-                    error=str(e),
-                )
-                raise
-            # 2. shift traffic replica-by-replica: leave the dispatch
-            #    set, let accepted vN work finish, swap the runner,
-            #    rejoin — peers absorb the load meanwhile. State writes
-            #    go under the replica's lock: the health monitor also
-            #    writes state, and an unsynchronized interleave could
-            #    re-admit traffic to the replica this loop is draining.
+            standby = self._warm_standbys(
+                self.replicas, new_artifact_ref, new_version, status
+            )
+            # 2. shift traffic replica-by-replica (helper shared with
+            #    the canary promote path)
             status["state"] = SWAP_SHIFTING
-            with self._lock:
-                self._swap_status = dict(status)
+            self._set_swap_status(status)
             for r, runner in zip(self.replicas, standby):
                 if self._draining.is_set():
                     # the pool is being torn down mid-rollout: stop
@@ -573,38 +912,14 @@ class ReplicaPool:
                         state=SWAP_FAILED,
                         error="pool drained mid-swap",
                     )
-                    with self._lock:
-                        self._swap_status = dict(status)
+                    self._set_swap_status(status)
                     self._emit(
                         "swap", phase="failed",
                         version_to=str(new_version),
                         error="pool drained mid-swap",
                     )
                     return dict(status)
-                with r._lock:
-                    r.state = SHIFTING
-                deadline = time.monotonic() + max(
-                    self.wedge_timeout_s, 1.0
-                )
-                while not r.idle() and time.monotonic() < deadline:
-                    time.sleep(0.005)
-                # capture the drain outcome BEFORE the runner swaps and
-                # the replica rejoins the dispatch set — after READY,
-                # peers' vN+1 batches land on it and "queue empty now"
-                # no longer says anything about how the vN drain went
-                drained_clean = r.idle()
-                r.swap_runner(runner, str(new_version))
-                with r._lock:
-                    r.state = READY
-                status["replicas_shifted"] += 1
-                with self._lock:
-                    self._swap_status = dict(status)
-                self._emit(
-                    "swap", phase="shift", replica=r.rid,
-                    device=r.device, version_from=status["version_from"],
-                    version_to=str(new_version),
-                    drained_clean=drained_clean,
-                )
+                self._shift_one(r, runner, new_version, status)
             # 3. vN is drained (no replica runs it anymore); retire it
             old_version = self.version
             self.version = str(new_version)
@@ -624,6 +939,315 @@ class ReplicaPool:
         finally:
             self._swap_lock.release()
 
+    # -- canary rollout (serve/canary.py) ------------------------------
+
+    def _cohort_snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            counts = self._cohort_counts or {}
+            return {c: dict(v) for c, v in counts.items()}
+
+    def _rollback_canaries(
+        self,
+        cans: Sequence[Replica],
+        status: Dict[str, Any],
+        old_ref: Any,
+        old_version: str,
+        old_runners: Dict[int, Callable],
+    ) -> None:
+        """Drain each canary replica's accepted vN+1 work, restore vN,
+        rejoin. The vN runner is REBUILT through the factory when
+        possible (keeps the factory's resident-cache accounting
+        truthful); when the factory fails, the RETAINED vN runner
+        object is restored instead — a rollback must never depend on a
+        possibly-broken factory to get back to the version that was
+        serving fine a minute ago. The registry is untouched either
+        way."""
+        for r in cans:
+            try:
+                runner = self.runner_factory(
+                    old_ref, self._device_objs[r.rid]
+                )
+                restored = "rebuilt"
+            except Exception:
+                runner = old_runners[r.rid]
+                restored = "retained"
+            drained_clean = self._drain_and_swap(
+                r, runner, old_version, canary=False
+            )
+            self._emit(
+                "canary", phase="rollback", replica=r.rid,
+                device=r.device, version_restored=old_version,
+                runner=restored, drained_clean=drained_clean,
+            )
+
+    def canary_swap(
+        self,
+        new_artifact_ref: Any,
+        new_version: str,
+        monitor,
+        *,
+        fraction: float,
+        canary_replicas: int = 1,
+        shadow_every: int = 8,
+        seed: int = 0,
+    ) -> Dict[str, Any]:
+        """The self-driving rollout: :meth:`swap` extended with a
+        canary stage whose live verdict decides the outcome.
+
+        1. **warm** — standby vN+1 runners for the canary replica
+           subset only (the LAST ``canary_replicas`` replicas); a
+           failing factory aborts with vN fully serving.
+        2. **canary** — the subset shifts to vN+1, the seeded
+           ``fraction`` of batches routes to it, sampled incumbent
+           batches mirror onto it for the logit-drift probe, and the
+           ``monitor`` (serve/canary.py) compares the cohorts' live
+           windows every ``eval_interval_s``.
+        3. **decision** — ``promote``: the remaining replicas warm and
+           shift exactly like :meth:`swap` and the pool retires vN;
+           ``rollback``: the canary replicas drain their vN+1 work and
+           restore vN — the registry untouched, the pool version
+           unchanged, the episode recorded. An expired observation
+           budget rolls back as ``inconclusive``.
+
+        Blocking (run on the admin rollout thread, like swap); one
+        rollout at a time. Returns the final status dict whose
+        ``canary`` key is the monitor's full evidence block."""
+        if not self._swap_lock.acquire(blocking=False):
+            raise RuntimeError("a swap is already in progress")
+        try:
+            t0 = time.monotonic()
+            n_can = max(int(canary_replicas), 1)
+            if n_can >= len(self.replicas):
+                raise ValueError(
+                    "a canary needs at least one incumbent replica: "
+                    f"canary_replicas={n_can} of "
+                    f"{len(self.replicas)} total"
+                )
+            cans = list(self.replicas[-n_can:])
+            rest = list(self.replicas[:-n_can])
+            old_version = self.version
+            old_ref = self.artifact_ref
+            old_runners = {r.rid: r._runner for r in cans}
+            status: Dict[str, Any] = {
+                "state": SWAP_CANARY_WARMING,
+                "version_from": old_version,
+                "version_to": str(new_version),
+                "replicas_total": len(self.replicas),
+                "replicas_shifted": 0,
+                "canary": None,
+            }
+            self._set_swap_status(status)
+            self._emit(
+                "swap", phase="start", version_from=old_version,
+                version_to=str(new_version),
+                replicas=len(self.replicas), canary=True,
+            )
+            self._emit(
+                "canary", phase="start", version_from=old_version,
+                version_to=str(new_version), fraction=float(fraction),
+                replicas_canary=[r.rid for r in cans],
+                shadow_every=int(shadow_every),
+            )
+            standby_can = self._warm_standbys(
+                cans, new_artifact_ref, new_version, status, canary=True
+            )
+            # cohort routing activates BEFORE the subset shifts:
+            # canary-assigned batches that arrive while the subset is
+            # still shifting fall back to the incumbent (counted),
+            # never leak unbounded traffic onto vN+1. The MONITOR is
+            # armed only at observation start below — every feed from
+            # the shift window (queue waits behind the draining
+            # replica, fallback floods) is drain physics, not health
+            # evidence, and an inactive monitor drops it.
+            self._start_shadow(monitor)
+            with self._lock:
+                self._canary_seq = 0
+                self._cohort_counts = {
+                    c: {
+                        "assigned_batches": 0,
+                        "assigned_requests": 0,
+                        "sheds": 0,
+                        "fallbacks": 0,
+                        "failed_requests": 0,
+                    }
+                    for c in ("incumbent", "canary")
+                }
+            for r in self.replicas:
+                r._on_batch = monitor.record_batch
+            self._canary = {
+                "seed": int(seed),
+                "fraction": float(fraction),
+                "version_to": str(new_version),
+                "shadow_every": int(shadow_every),
+            }
+            status["state"] = SWAP_CANARY
+            self._set_swap_status(status)
+            aborted = False
+            try:
+                for r, runner in zip(cans, standby_can):
+                    if self._draining.is_set():
+                        aborted = True
+                        break
+                    self._shift_one(
+                        r, runner, new_version, status, canary=True
+                    )
+                # the observation loop: the monitor's verdict drives
+                # the state machine, no human in it
+                decision: Optional[Dict[str, Any]] = None
+                if not aborted:
+                    # observation starts HERE: zero the cohort
+                    # counters and only now arm the monitor. Routing
+                    # was live through the subset's shift (by design),
+                    # so that window's canary-assigned batches FELL
+                    # BACK mechanically and everything queued behind
+                    # the draining replica carried drain-sized waits —
+                    # left in, a slow subset drain would pin the
+                    # unabsorbed ratio near 1.0 (or the queue-share
+                    # delta near 1) and roll back a perfectly healthy
+                    # canary on its own shift physics.
+                    with self._lock:
+                        for c in self._cohort_counts.values():
+                            for k in c:
+                                c[k] = 0
+                    monitor.arm(
+                        version_from=old_version,
+                        version_to=str(new_version),
+                        fraction=float(fraction),
+                        replicas=[r.rid for r in cans],
+                    )
+                    self._emit(
+                        "canary", phase="observing",
+                        version_to=str(new_version),
+                        eval_interval_s=monitor.cfg.eval_interval_s,
+                        max_wait_s=monitor.cfg.max_wait_s,
+                    )
+                    deadline = time.monotonic() + monitor.cfg.max_wait_s
+                    while True:
+                        if self._draining.is_set():
+                            aborted = True
+                            break
+                        res = monitor.evaluate(self._cohort_snapshot())
+                        if res["decision"] != "observe":
+                            decision = res
+                            break
+                        if time.monotonic() >= deadline:
+                            decision = monitor.conclude("timeout")
+                            break
+                        time.sleep(monitor.cfg.eval_interval_s)
+            finally:
+                # cohort routing + feeds off before any resolution
+                # path runs: promote/rollback shifts must dispatch
+                # freely, and a teardown mid-observation must not
+                # leave routing pinned to a half-rolled pool
+                self._canary = None
+                for r in self.replicas:
+                    r._on_batch = None
+                self._stop_shadow()
+                monitor.disarm()
+            if aborted:
+                status.update(
+                    state=SWAP_FAILED, error="pool drained mid-canary",
+                    canary=monitor.report(dict(self._shadow_stats)),
+                )
+                self._set_swap_status(status)
+                self._emit(
+                    "swap", phase="failed", version_to=str(new_version),
+                    error="pool drained mid-canary",
+                )
+                return dict(status)
+            if decision["decision"] == "promote":
+                try:
+                    standby_rest = self._warm_standbys(
+                        rest, new_artifact_ref, new_version, status
+                    )
+                except Exception:
+                    # promote-warm failed with a mixed fleet: restore
+                    # the canary replicas to vN so the pool is whole
+                    # on the incumbent again, then report FAILED
+                    self._rollback_canaries(
+                        cans, status, old_ref, old_version, old_runners
+                    )
+                    status["canary"] = monitor.report(
+                        dict(self._shadow_stats)
+                    )
+                    self._set_swap_status(status)
+                    return dict(status)
+                status["state"] = SWAP_SHIFTING
+                self._set_swap_status(status)
+                for r, runner in zip(rest, standby_rest):
+                    if self._draining.is_set():
+                        status.update(
+                            state=SWAP_FAILED,
+                            error="pool drained mid-swap",
+                            canary=monitor.report(
+                                dict(self._shadow_stats)
+                            ),
+                        )
+                        self._set_swap_status(status)
+                        self._emit(
+                            "swap", phase="failed",
+                            version_to=str(new_version),
+                            error="pool drained mid-swap",
+                        )
+                        return dict(status)
+                    self._shift_one(r, runner, new_version, status)
+                for r in cans:
+                    with r._lock:
+                        r.canary = False
+                self.version = str(new_version)
+                self.artifact_ref = new_artifact_ref
+                canary_block = monitor.report(dict(self._shadow_stats))
+                canary_block["promote_s"] = round(
+                    time.monotonic() - t0, 3
+                )
+                status.update(
+                    state=SWAP_DONE,
+                    seconds=round(time.monotonic() - t0, 3),
+                    canary=canary_block,
+                )
+                self._set_swap_status(status)
+                self._emit(
+                    "canary", phase="promote",
+                    version_from=old_version,
+                    version_to=str(new_version),
+                    seconds=canary_block["promote_s"],
+                    evaluations=canary_block["evaluations"],
+                )
+                self._emit(
+                    "swap", phase="done", version_from=old_version,
+                    version_to=str(new_version),
+                    seconds=status["seconds"],
+                    replicas_shifted=status["replicas_shifted"],
+                )
+                return dict(status)
+            # rollback (a fired detector, or inconclusive at budget)
+            status["state"] = SWAP_ROLLING_BACK
+            self._set_swap_status(status)
+            self._rollback_canaries(
+                cans, status, old_ref, old_version, old_runners
+            )
+            canary_block = monitor.report(dict(self._shadow_stats))
+            canary_block["promote_s"] = None
+            status.update(
+                state=SWAP_ROLLED_BACK,
+                seconds=round(time.monotonic() - t0, 3),
+                canary=canary_block,
+                error=None,
+            )
+            self._set_swap_status(status)
+            self._emit(
+                "swap", phase="rolled_back",
+                version_from=old_version,
+                version_to=str(new_version),
+                trigger=canary_block["trigger"],
+                seconds=status["seconds"],
+            )
+            return dict(status)
+        finally:
+            self._canary = None
+            self._stop_shadow()
+            self._swap_lock.release()
+
     # -- lifecycle / reporting -----------------------------------------
 
     def drain(self, timeout: float = 60.0) -> bool:
@@ -632,6 +1256,7 @@ class ReplicaPool:
         Future resolves before this returns True."""
         self._draining.set()
         self._monitor_stop.set()
+        self._stop_shadow()
         deadline = time.monotonic() + timeout
         clean = True
         # monitor FIRST: a restart racing the replica stops below would
@@ -659,7 +1284,14 @@ class ReplicaPool:
             shed_requests = self.shed_requests
             dispatched = self.dispatched
             by_version = dict(self.completed_by_version)
+            failed_by_version = dict(self.failed_by_version)
             swap_status = dict(self._swap_status)
+            canary = self._canary
+            cohorts = (
+                {c: dict(v) for c, v in self._cohort_counts.items()}
+                if self._cohort_counts is not None else None
+            )
+            shadow = dict(self._shadow_stats)
         reps = [r.snapshot() for r in self.replicas]
         batches = sum(r["batches"] for r in reps)
         return {
@@ -673,7 +1305,14 @@ class ReplicaPool:
             "completed": sum(r["completed"] for r in reps),
             "restarts": sum(r["restarts"] for r in reps),
             "completed_by_version": by_version,
+            "failed_by_version": failed_by_version,
             "swap": swap_status,
+            # live canary routing state: None outside an observation
+            # window; the cohort counters persist past the decision so
+            # the verdict's evidence survives the teardown
+            "canary_active": canary is not None,
+            "cohorts": cohorts,
+            "shadow": shadow,
         }
 
 
@@ -689,6 +1328,13 @@ class PoolAdmin:
     ``shed_counter`` (optional) is polled at swap start/end so the
     swap report can pin "shed caused during the swap window" — the
     number the zero-shed-due-to-swap acceptance gate reads.
+
+    ``canary`` (optional) configures the self-driving rollout
+    (serve/canary.py): ``{"monitor": CanaryMonitor, "fraction": f,
+    "replicas": n, "shadow_every": k, "seed": s}``. When set, every
+    triggered rollout runs :meth:`ReplicaPool.canary_swap` — the
+    monitor's live verdict decides promote vs auto-rollback — unless
+    the swap body explicitly opts out with ``{"canary": false}``.
     """
 
     def __init__(
@@ -697,10 +1343,12 @@ class PoolAdmin:
         *,
         registry: Any = None,
         shed_counter: Optional[Callable[[], int]] = None,
+        canary: Optional[Dict[str, Any]] = None,
     ):
         self.pool = pool
         self.registry = registry
         self.shed_counter = shed_counter
+        self.canary = canary
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._last_swap: Optional[Dict[str, Any]] = None
@@ -786,9 +1434,27 @@ class PoolAdmin:
             )
             self._requested = label
 
+            canary = (
+                self.canary
+                if self.canary is not None
+                and spec.get("canary", True) is not False
+                else None
+            )
+
             def _run():
                 try:
-                    status = self.pool.swap(artifact_dir, label)
+                    if canary is not None:
+                        status = self.pool.canary_swap(
+                            artifact_dir,
+                            label,
+                            canary["monitor"],
+                            fraction=canary["fraction"],
+                            canary_replicas=canary.get("replicas", 1),
+                            shadow_every=canary.get("shadow_every", 8),
+                            seed=canary.get("seed", 0),
+                        )
+                    else:
+                        status = self.pool.swap(artifact_dir, label)
                 except Exception as e:
                     # the pool records a FULL failed status
                     # (version_from, replicas_total, ...) before
@@ -889,6 +1555,17 @@ class PoolAdmin:
             "answered_by": stats["completed_by_version"],
         }
 
+    def canary_report(self) -> Optional[Dict[str, Any]]:
+        """The verdict's nullable ``canary`` block (SLO verdict v5):
+        the last rollout's canary-episode evidence, or None when no
+        canary stage ever ran (plain swaps, pre-canary runs) so
+        ``compare``'s canary metrics skip cleanly."""
+        with self._lock:
+            last = dict(self._last_swap) if self._last_swap else None
+        if last is None or last.get("canary") is None:
+            return None
+        return dict(last["canary"])
+
 
 def replica_stats_fields(ps: Dict[str, Any]) -> Dict[str, Any]:
     """The ``replica phase=stats`` event payload over a
@@ -902,12 +1579,15 @@ def replica_stats_fields(ps: Dict[str, Any]) -> Dict[str, Any]:
         "restarts": ps["restarts"],
         "completed_by_version": ps["completed_by_version"],
         "swap": ps["swap"],
+        "canary_active": ps.get("canary_active", False),
+        "cohorts": ps.get("cohorts"),
         "replicas": [
             {
                 "replica": r["replica"],
                 "device": r["device"],
                 "version": r["version"],
                 "state": r["state"],
+                "canary": r.get("canary", False),
                 "queue_depth": r["queue_depth"],
                 "completed": r["completed"],
             }
@@ -1159,6 +1839,80 @@ def single_engine_resident_block(
     }
 
 
+def _apply_degradation(
+    runner: Callable[[List[Any]], Any],
+    spec: Optional[Dict[str, Any]],
+    artifact_ref: Any,
+    device: Any,
+) -> Callable[[List[Any]], Any]:
+    """Fault-injection wrapper for canary drills and tests: degrade
+    ONE version's runners with injectable latency inflation, engine
+    failures, or logit perturbation, leaving every other version
+    untouched.
+
+    ``spec`` keys (all optional except that at least one fault must be
+    nonzero to wrap):
+
+    - ``artifact`` — the artifact ref the degradation targets; a
+      runner built for any OTHER ref is returned UNWRAPPED (the
+      zero-cost-when-inactive contract: disabled means the plain
+      runner object, not a pass-through shim).
+    - ``latency_ms`` — sleep this long before answering a batch that
+      contains a matched payload.
+    - ``error_rate`` — probability (per matched batch, seeded per
+      device) of raising instead of answering — an ENGINE failure,
+      ledgered as failed, never as load shedding.
+    - ``logit_eps`` — added to the matched rows' logits (per-row, so a
+      perturbation can target a payload subset exactly) — what the
+      shadow logit-drift probe exists to catch.
+    - ``match`` — ``callable(payload) -> bool`` selecting payloads
+      (None = every payload). The acceptance e2e marks its premium
+      class's request bodies and matches on the marker, so the
+      injected degradation hits ONLY priority 0.
+    - ``seed`` — the error-draw seed (keyed with the device label, so
+      replicas degrade independently but reproducibly).
+    """
+    if spec is None:
+        return runner
+    target = spec.get("artifact")
+    if target is not None and str(target) != str(artifact_ref):
+        return runner
+    latency_s = float(spec.get("latency_ms", 0.0)) / 1000.0
+    error_rate = float(spec.get("error_rate", 0.0))
+    eps = float(spec.get("logit_eps", 0.0))
+    if latency_s <= 0 and error_rate <= 0 and eps == 0:
+        return runner
+    match = spec.get("match")
+    import random as _random
+
+    rng = _random.Random(f"{spec.get('seed', 0)}:{device}")
+
+    def degraded(payloads: List[Any]):
+        import numpy as np
+
+        hits = [
+            i for i, p in enumerate(payloads)
+            if match is None or match(p)
+        ]
+        if hits and latency_s > 0:
+            time.sleep(latency_s)
+        if hits and error_rate > 0 and rng.random() < error_rate:
+            raise RuntimeError(
+                "injected engine failure (degradation hook)"
+            )
+        out = runner(payloads)
+        if hits and eps:
+            out = [np.asarray(x) for x in list(out)]
+            for i in hits:
+                out[i] = out[i] + eps
+        return out
+
+    # the marker the zero-cost pin asserts is ABSENT on undegraded
+    # runners: disabled injection returns the plain runner object
+    degraded.degraded = True
+    return degraded
+
+
 def make_engine_runner_factory(
     buckets: Sequence[int],
     *,
@@ -1169,6 +1923,7 @@ def make_engine_runner_factory(
     resident_models: int = 1,
     model_dirs: Optional[Dict[str, str]] = None,
     on_event: Optional[Callable[..., Any]] = None,
+    degrade: Optional[Dict[str, Any]] = None,
 ) -> Callable[[str, Any], Callable[[List[Any]], Any]]:
     """The real runner factory: ``factory(artifact_dir, device) ->
     runner`` builds an :class:`~bdbnn_tpu.serve.engine.InferenceEngine`
@@ -1186,6 +1941,11 @@ def make_engine_runner_factory(
     the factory's own ``artifact_dir`` argument). Every cache built is
     appended to ``factory.caches`` so the orchestration can assemble
     the verdict's ``resident`` block.
+
+    ``degrade`` (fault injection — canary drills and tests only)
+    wraps the runners built for ONE targeted artifact ref with
+    :func:`_apply_degradation`; runners for every other ref come back
+    unwrapped, so the hook is zero-cost when inactive.
 
     ``pace_ms > 0`` swaps the engine's compute for a fixed sleep per
     batch (weights never load, nothing compiles): the serving-fabric
@@ -1208,7 +1968,7 @@ def make_engine_runner_factory(
                 time.sleep(pace_s)
                 return [np.zeros((1,), np.float32)] * len(payloads)
 
-            return paced
+            return _apply_degradation(paced, degrade, artifact_dir, device)
         from bdbnn_tpu.serve.engine import InferenceEngine
 
         def load_model(key: str):
@@ -1262,7 +2022,7 @@ def make_engine_runner_factory(
                 np.stack(payloads)
             )
 
-        return runner
+        return _apply_degradation(runner, degrade, artifact_dir, device)
 
     factory.caches = caches
     return factory
@@ -1273,9 +2033,13 @@ __all__ = [
     "READY",
     "SHIFTING",
     "STOPPED",
+    "SWAP_CANARY",
+    "SWAP_CANARY_WARMING",
     "SWAP_DONE",
     "SWAP_FAILED",
     "SWAP_IDLE",
+    "SWAP_ROLLED_BACK",
+    "SWAP_ROLLING_BACK",
     "SWAP_SHIFTING",
     "SWAP_WARMING",
     "UNHEALTHY",

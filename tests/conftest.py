@@ -92,6 +92,32 @@ def rng():
     return np.random.default_rng(0)
 
 
+def retry_once_flaky(attempt, *, note, exceptions=(AssertionError,)):
+    """THE quarantine policy for known timing-sensitive transients —
+    one place, one contract (PR 11; unifies the copies that had grown
+    in test_multihost, the test_pod_faults cluster-formation fixture
+    and the paced scaling sweep).
+
+    ``attempt(i)`` runs one attempt (``i`` = 0 or 1, so callers can
+    vary workdirs per attempt) and raises one of ``exceptions`` on
+    failure. Policy: the FIRST failure is surfaced as a warning
+    carrying the caller's tracking ``note`` (a recurring flake stays
+    visible in -W summaries instead of vanishing), then exactly ONE
+    retry runs. A deterministic failure fails BOTH attempts and still
+    fails the suite — the retry masks box contention, never a real
+    regression. Do not wrap a test in this without a tracking note
+    naming the documented transient it quarantines."""
+    import warnings
+
+    try:
+        return attempt(0)
+    except exceptions as first:
+        warnings.warn(
+            f"{note} — known transient, retrying once: {first}"
+        )
+        return attempt(1)
+
+
 # ---------------------------------------------------------------------------
 # Tier-1 wall-budget guard: the ROADMAP command runs the not-slow tier
 # under `timeout -k 10 870`; drifting past that used to be discovered

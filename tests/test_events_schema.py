@@ -41,10 +41,16 @@ SCANNED = sorted(
 
 
 def _emit_calls(path):
-    """(lineno, first-arg AST node) for every ``<obj>.emit(...)`` call.
+    """(lineno, first-arg AST node) for every ``<obj>.emit(...)`` or
+    ``<obj>._emit(...)`` call — the latter are the telemetry-relay
+    wrappers (serve/pool.py, serve/canary.py) that forward
+    ``(kind, **fields)`` to an injected ``on_event`` hook, which the
+    orchestrations wire to ``EventWriter.emit``; their literal kinds
+    must be registered exactly like direct emits, or the canary/shadow
+    channel could drift unregistered.
 
     ``EventWriter.emit``'s own definition isn't a call; dict ``.items``
-    etc. don't match the attribute name."""
+    etc. don't match the attribute names."""
     with open(path) as f:
         tree = ast.parse(f.read(), filename=path)
     out = []
@@ -52,7 +58,7 @@ def _emit_calls(path):
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "emit"
+            and node.func.attr in ("emit", "_emit")
         ):
             # ProgressLog.emit(step, parts) takes an int first — only
             # event emits pass a string literal or anything else; the
@@ -94,12 +100,14 @@ class TestEmitCallSites:
         # swap trigger), which must keep real call sites
         # ... and the request-path tracing kind (serve/http.py +
         # serve/loadgen.py sampled waterfalls and stats heartbeats)
+        # ... and the canary-rollout kinds (serve/canary.py monitor
+        # evaluations/decisions + serve/pool.py shadow-mirror probe)
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
                 "alert", "health", "export", "serve",
                 "http", "admission", "replica", "swap",
-                "rtrace"} <= found
+                "rtrace", "canary", "shadow"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync."""
@@ -418,6 +426,82 @@ class TestStrictRfc8259:
         assert lines[1]["queue_share"] == pytest.approx(0.31, abs=1e-3)
         # the emit() return values match what was written
         assert w["stages"]["queue"] is None and s["requests"] == 1200
+
+    def test_canary_shadow_kind_payloads_roundtrip(self, tmp_path):
+        """The canary-rollout payload shapes (serve/canary.py via
+        serve/pool.py) with adversarial values in the numeric slots: a
+        NaN drift must land as null (never a bare token), numpy
+        counters must unwrap, and the nested per-detector evidence
+        table must survive strict parsing."""
+        ev = EventWriter(str(tmp_path))
+        e = ev.emit(
+            "canary",
+            phase="evaluate",
+            evaluation=np.int64(7),
+            decision="observe",
+            trigger=None,
+            clean_streak=np.int64(2),
+            canary_served=np.int64(40),
+            incumbent_served=120,
+            detectors={
+                "p99_p0": {
+                    "value": np.float32(1.25), "threshold": 2.0,
+                    "breach": np.bool_(False), "fired": False,
+                    "eligible": np.bool_(True),
+                    "canary_p99_ms": np.float32(12.5),
+                    "incumbent_p99_ms": float("nan"),
+                    "canary_n": np.int64(40), "incumbent_n": 120,
+                },
+                "logit_drift": {
+                    "value": float("inf"), "threshold": 0.0,
+                    "breach": True, "fired": np.bool_(True),
+                    "eligible": True, "compared": np.int64(9),
+                },
+            },
+        )
+        d = ev.emit(
+            "canary",
+            phase="decision",
+            decision="rollback",
+            trigger="logit_drift",
+            reason="timeout",
+            evaluations=np.int64(11),
+        )
+        s = ev.emit(
+            "shadow",
+            phase="mirror",
+            seq=np.int64(42),
+            drift=float("nan"),
+            version_from="v0001",
+            version_to="v0002",
+        )
+        s2 = ev.emit(
+            "shadow", phase="mirror", seq=43, drift=np.float32(0.25),
+            version_from="v0001", version_to="v0002",
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "canary"
+        assert lines[0]["evaluation"] == 7
+        assert isinstance(lines[0]["evaluation"], int)
+        dets = lines[0]["detectors"]
+        # NaN/Inf evidence -> null; numpy bools/ints unwrap; the
+        # nested per-detector table survives strict parsing intact
+        assert dets["p99_p0"]["incumbent_p99_ms"] is None
+        assert dets["p99_p0"]["eligible"] is True
+        assert dets["p99_p0"]["canary_n"] == 40
+        assert dets["logit_drift"]["value"] is None  # Inf -> null
+        assert dets["logit_drift"]["fired"] is True
+        assert lines[1]["trigger"] == "logit_drift"
+        assert lines[1]["evaluations"] == 11
+        assert lines[2]["kind"] == "shadow"
+        assert lines[2]["drift"] is None  # NaN -> null, never a token
+        assert lines[3]["drift"] == 0.25
+        # the emit() return values match what was written
+        assert e["detectors"]["logit_drift"]["value"] is None
+        assert d["evaluations"] == 11
+        assert s["drift"] is None and s2["seq"] == 43
 
     def test_resilience_kind_payloads_roundtrip(self, tmp_path):
         """The extended pod-resilience payload shapes (train/loop.py):

@@ -170,28 +170,28 @@ class TestCoordinatedPreemption:
 
     @pytest.fixture(scope="class")
     def pod_victim(self, tmp_path_factory):
-        """Bounded retry-once around cluster formation — the same
-        policy as tests/test_multihost.py, for the same documented
-        transient (PR 7/8/9 notes: a worker dying or timing out during
-        GRPC coordinator bring-up on a contended box; in-suite ERRORs
-        that never reproduce in isolation). Only the did-the-cluster-
-        form assertion retries; every post-formation contract is
-        asserted by the tests and fails deterministically."""
-        import warnings
+        """Cluster formation quarantined behind
+        conftest.retry_once_flaky (the ONE bounded retry-once policy),
+        for the documented transient (PR 7/8/9 notes: a worker dying
+        or timing out during GRPC coordinator bring-up on a contended
+        box; in-suite ERRORs that never reproduce in isolation). Only
+        the did-the-cluster-form assertion retries; every
+        post-formation contract is asserted by the tests and fails
+        deterministically."""
+        from conftest import retry_once_flaky
 
-        try:
-            return self._spawn_victim_attempt(
-                tmp_path_factory.mktemp("pod_sigterm")
-            )
-        except AssertionError as first:
-            warnings.warn(
-                "pod cluster attempt 1 never formed (known transient "
-                "on contended boxes, PR 7/8/9 notes) — retrying once: "
-                f"{first}"
-            )
-            return self._spawn_victim_attempt(
-                tmp_path_factory.mktemp("pod_sigterm_retry")
-            )
+        return retry_once_flaky(
+            lambda i: self._spawn_victim_attempt(
+                tmp_path_factory.mktemp(
+                    "pod_sigterm" if i == 0 else "pod_sigterm_retry"
+                )
+            ),
+            note=(
+                "pod cluster attempt 1 never formed (GRPC coordinator "
+                "bring-up transient on contended boxes, PR 7/8/9 "
+                "notes)"
+            ),
+        )
 
     def test_every_host_exits_75(self, pod_victim):
         rcs = [rc for rc, _, _ in pod_victim["outs"]]

@@ -390,7 +390,7 @@ class TestLoadGen:
         )
         assert parsed["shed_rate"] == 0.2
         assert parsed["requests_completed"] == 8
-        assert parsed["serve_verdict"] == 4
+        assert parsed["serve_verdict"] == 5
         # v1 consumers: the v2 blocks exist but are null on a plain
         # serve-bench verdict
         assert parsed["per_priority"] is None
@@ -501,8 +501,18 @@ class TestExportArtifact:
         art_dir, artifact = exported_artifact
         exports = read_events(tiny_trained_run_dir, "export")
         assert exports, "export left no event on the source run"
-        e = exports[-1]
-        assert e["artifact"] == os.path.abspath(art_dir)
+        # several tests export from the shared session run dir (the
+        # CLI subprocess smoke among them), so match THIS export's
+        # event by its artifact path instead of assuming it was last —
+        # in-suite ordering must not decide which event is newest
+        e = next(
+            (
+                e for e in exports
+                if e["artifact"] == os.path.abspath(art_dir)
+            ),
+            None,
+        )
+        assert e is not None, [x["artifact"] for x in exports]
         assert e["integrity"] == "ok"
         assert e["checkpoint_acc1"] == artifact["eval"]["checkpoint_acc1"]
 
