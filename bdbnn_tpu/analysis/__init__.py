@@ -1,0 +1,52 @@
+"""Project-native static analysis: the ``check`` CLI's engine.
+
+Generic linters know nothing about this repo's load-bearing
+invariants: that 54 lock sites in ``serve/pool.py`` guard specific
+attributes, that the jitted forward must stay trace-pure so packed
+1-bit inference stays bitwise-exact, that every ``EventWriter.emit``
+kind is registered, or that ``compare``'s serve-metric namespace must
+agree with the verdict producers and the golden fixture. Each of those
+contracts was previously enforced by reviewer vigilance — and each has
+a PR where vigilance failed (the restart-clobbers-SHIFTING race, the
+shed-reason misattribution, verdict-key drift). This package enforces
+them mechanically, as a tier-1 gate:
+
+- :mod:`~bdbnn_tpu.analysis.core` — the shared framework: Finding
+  records (``file:line:checker-id:message``), AST/file discovery, the
+  suppression baseline (sorted, deduplicated, every entry justified —
+  a stale suppression is itself a finding), and the deterministic
+  strict-JSON report.
+- :mod:`~bdbnn_tpu.analysis.lockcheck` — ``lock-discipline``:
+  ``# guarded-by: <lock>`` annotated attributes must only be written /
+  read-modify-written / mutated under ``with self.<lock>``.
+- :mod:`~bdbnn_tpu.analysis.jitpure` — ``jit-purity``: functions
+  reachable from jit/AOT call sites must not call host-sync or
+  nondeterminism primitives.
+- :mod:`~bdbnn_tpu.analysis.eventschema` — ``event-schema``: the
+  ``tests/test_events_schema.py`` AST scan promoted into the package.
+- :mod:`~bdbnn_tpu.analysis.verdictcheck` — ``verdict-coherence``:
+  ``obs/compare.py``'s serve-metric flattener vs METRIC_SPECS vs the
+  golden fixture vs the verdict-producing sites.
+
+Stdlib-only (the obs rule): running the analyzer never initializes a
+JAX backend, so it is cheap enough to run on every CI pass and from
+``python -m bdbnn_tpu.cli check`` on a laptop.
+"""
+
+from bdbnn_tpu.analysis.core import (
+    BASELINE_NAME,
+    CHECKER_IDS,
+    Finding,
+    load_baseline,
+    render_report,
+    run_check,
+)
+
+__all__ = [
+    "BASELINE_NAME",
+    "CHECKER_IDS",
+    "Finding",
+    "load_baseline",
+    "render_report",
+    "run_check",
+]

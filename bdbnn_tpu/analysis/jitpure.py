@@ -1,0 +1,286 @@
+"""``jit-purity``: jitted code must stay trace-pure and deterministic.
+
+The packed-inference exactness contract — bitwise-equal 1-bit forward,
+the zero-tolerance shadow logit-drift probe, the canary drift gate —
+all assume that what ``jax.jit`` traced is a pure function of its
+inputs. A host-sync or nondeterminism primitive inside a traced
+function breaks that silently: ``time.*`` / ``random.*`` /
+``np.random.*`` calls bake one trace-time value into the compiled
+program (or retrace), ``.item()`` / ``device_get`` force a host sync
+mid-step, and ``print``/``logging`` fire at trace time only — the
+classic "my debug print ran once" confusion.
+
+The checker builds a conservative name-based call graph over the **jit
+domain** (``nn/``, ``models/``, ``losses/``, ``train/step.py``,
+``serve/engine.py``, ``obs/probes.py``, ``parallel/mesh.py``):
+
+- **roots** — arguments of ``jax.jit(...)`` / ``pjit(...)`` calls and
+  ``@jit``-style decorators anywhere in the scan set (``jit(f)`` marks
+  ``f``; ``jit(make_step(...))`` marks the factory ``make_step``,
+  whose body contains the traced closure), the ``__call__``/``setup``
+  methods of flax ``nn.Module`` classes (always traced), and —
+  higher-order wrappers — when a function jits one of its OWN
+  parameters (``jit_train_step(step_fn)``), every call to that wrapper
+  marks its argument as a root.
+- **closure** — from each root, every call by name that resolves to a
+  function defined in the jit domain is reachable (over-approximate on
+  purpose: a false edge costs a spurious look, a missed edge costs a
+  missed host sync).
+- **ban list** — inside reachable functions: ``time.*``,
+  ``random.*``, ``np.random.*`` / ``numpy.random.*``, ``.item()``,
+  ``jax.device_get`` / ``device_get``, ``print`` and ``logging.*``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from bdbnn_tpu.analysis.core import Finding, relpath
+
+CHECKER_ID = "jit-purity"
+
+# default jit domain, relative to the repo root (prefix match)
+JIT_DOMAIN = (
+    "bdbnn_tpu/nn/", "bdbnn_tpu/models/", "bdbnn_tpu/losses/",
+    "bdbnn_tpu/train/step.py", "bdbnn_tpu/serve/engine.py",
+    "bdbnn_tpu/obs/probes.py", "bdbnn_tpu/parallel/mesh.py",
+)
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit_func(func: ast.expr) -> bool:
+    """``jit`` / ``jax.jit`` / ``jax.experimental.pjit.pjit`` ..."""
+    if isinstance(func, ast.Name):
+        return func.id in _JIT_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr in _JIT_NAMES
+    return False
+
+
+def _called_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _root_from_arg(arg: ast.expr) -> Optional[str]:
+    """The function name a jit argument marks reachable."""
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Attribute):
+        return arg.attr  # jax.jit(self._apply) -> "_apply"
+    if isinstance(arg, ast.Call):
+        return _called_name(arg.func)  # jit(make_step(...)) -> factory
+    return None
+
+
+def _banned(node: ast.Call) -> Optional[str]:
+    """The ban-list label for a call, or None."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id == "print":
+            return "print()"
+        if func.id == "device_get":
+            return "device_get()"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr == "item" and not node.args and not node.keywords:
+        return ".item() host sync"
+    base = func.value
+    if isinstance(base, ast.Name):
+        if base.id == "time":
+            return f"time.{func.attr}()"
+        if base.id == "random":
+            return f"random.{func.attr}()"
+        if base.id == "logging":
+            return f"logging.{func.attr}()"
+        if base.id == "jax" and func.attr == "device_get":
+            return "jax.device_get()"
+    if (
+        isinstance(base, ast.Attribute)
+        and base.attr == "random"
+        and isinstance(base.value, ast.Name)
+        and base.value.id in ("np", "numpy")
+    ):
+        return f"{base.value.id}.random.{func.attr}()"
+    return None
+
+
+class _Module:
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        # name -> function nodes (module-level defs AND methods; name
+        # collisions keep every candidate — over-approximation)
+        self.functions: Dict[str, List[ast.AST]] = {}
+
+
+def _is_flax_module(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else ""
+        )
+        if "Module" in name:
+            return True
+    return False
+
+
+def analyze_jit_purity(
+    root: str,
+    files: List[str],
+    *,
+    domain: Tuple[str, ...] = JIT_DOMAIN,
+) -> Tuple[List[Finding], Set[str], Set[str]]:
+    """``(findings, roots, reachable)`` — the full analysis. The roots
+    and reachable sets are exposed so the tier-1 floor test can pin
+    that the checker actually traversed the jit domain (a refactor
+    that silently empties the root set must fail loudly, not pass
+    vacuously)."""
+    findings: List[Finding] = []
+    index: Dict[str, List[Tuple[_Module, ast.AST]]] = {}
+    roots: Set[str] = set()
+    # wrapper name -> positional index of the parameter it jits
+    wrappers: Dict[str, int] = {}
+
+    rel_of = {p: relpath(p, root) for p in files}
+    # fixture-corpus mode: a scan set with no package files (the
+    # seeded-bad snippets under tests/fixtures/analysis/) is ALL domain
+    any_pkg = any(r.startswith("bdbnn_tpu/") for r in rel_of.values())
+    if any_pkg:
+        in_domain = {
+            p for p in files
+            if any(
+                rel_of[p] == d or rel_of[p].startswith(d)
+                for d in domain
+            )
+        }
+    else:
+        in_domain = set(files)
+
+    parsed: Dict[str, ast.Module] = {}
+    for path in files:
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        if "jit" not in src and path not in in_domain:
+            continue
+        try:
+            parsed[path] = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue  # lock checker reports unparseable files
+
+    # pass 1: function index over the jit domain + flax-module roots
+    for path, tree in parsed.items():
+        if path not in in_domain:
+            continue
+        mod = _Module(rel_of[path], tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.ClassDef) and _is_flax_module(node):
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and item.name in ("__call__", "setup"):
+                        roots.add(item.name)
+        for name, nodes in mod.functions.items():
+            index.setdefault(name, []).extend(
+                (mod, n) for n in nodes
+            )
+
+    # pass 2: jit roots + higher-order jit wrappers, over EVERY file
+    for path, tree in parsed.items():
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    if _is_jit_func(d):
+                        roots.add(node.name)
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and _called_name(dec.func) == "partial"
+                        and dec.args
+                        and _is_jit_func(dec.args[0])
+                    ):
+                        roots.add(node.name)
+                # a function that jits one of its own parameters is a
+                # jit WRAPPER: calls to it mark their argument
+                params = [a.arg for a in node.args.args]
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and _is_jit_func(sub.func)
+                        and sub.args
+                        and isinstance(sub.args[0], ast.Name)
+                        and sub.args[0].id in params
+                    ):
+                        wrappers[node.name] = params.index(sub.args[0].id)
+            elif isinstance(node, ast.Call) and _is_jit_func(node.func):
+                if node.args:
+                    name = _root_from_arg(node.args[0])
+                    if name:
+                        roots.add(name)
+    for path, tree in parsed.items():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _called_name(node.func)
+            if name in wrappers and len(node.args) > wrappers[name]:
+                arg_root = _root_from_arg(node.args[wrappers[name]])
+                if arg_root:
+                    roots.add(arg_root)
+
+    # pass 3: closure over the name-based call graph
+    reachable: Set[str] = set()
+    frontier = [r for r in roots if r in index]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for _mod, fn in index[name]:
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    callee = _called_name(sub.func)
+                    if callee and callee in index and (
+                        callee not in reachable
+                    ):
+                        frontier.append(callee)
+
+    # pass 4: ban list inside every reachable function
+    for name in sorted(reachable):
+        for mod, fn in index[name]:
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    label = _banned(sub)
+                    if label:
+                        findings.append(Finding(
+                            mod.rel, sub.lineno, CHECKER_ID,
+                            f"{label} inside jit-reachable "
+                            f"function {name!r} — host sync / "
+                            "nondeterminism in traced code",
+                        ))
+    return sorted(set(findings)), roots, reachable
+
+
+def check_jit_purity(
+    root: str,
+    files: List[str],
+    *,
+    domain: Tuple[str, ...] = JIT_DOMAIN,
+) -> List[Finding]:
+    findings, _roots, _reachable = analyze_jit_purity(
+        root, files, domain=domain
+    )
+    return findings
+
+
+__all__ = [
+    "CHECKER_ID", "JIT_DOMAIN", "analyze_jit_purity", "check_jit_purity",
+]

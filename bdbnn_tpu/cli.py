@@ -1099,6 +1099,90 @@ def serve_http_main(argv) -> int:
     return 0
 
 
+def check_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli check [--json] [--checker ID]`` — the
+    project-native static analyzer (bdbnn_tpu/analysis/): lock
+    discipline over the threaded serving classes, jit purity over the
+    traced forward/step functions, event-schema registry coherence and
+    compare-verdict key coherence. Exit codes: 0 clean (suppressed
+    findings allowed — the baseline carries a justification per
+    entry), 3 unsuppressed findings (baseline-hygiene problems — stale
+    / unjustified / unsorted suppressions — included). Reads files
+    only; never initializes a JAX backend."""
+    import json
+    import os
+
+    from bdbnn_tpu.analysis import CHECKER_IDS
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli check",
+        description="Run the project-native static-analysis checkers "
+        "over the package and report findings not covered by the "
+        "suppression baseline (analysis-baseline.txt).",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable report (deterministic strict "
+        "JSON) instead of the text rendering",
+    )
+    ap.add_argument(
+        "--checker", action="append", default=[], dest="checkers",
+        choices=list(CHECKER_IDS), metavar="ID",
+        help=f"run only this checker (repeatable); one of {CHECKER_IDS}",
+    )
+    ap.add_argument(
+        "--root", default="",
+        help="repo root to analyze (default: the root above the "
+        "installed package — the live tree)",
+    )
+    ap.add_argument(
+        "--baseline", default="",
+        help="suppression baseline path (default: "
+        "<root>/analysis-baseline.txt)",
+    )
+    ap.add_argument(
+        "--events-into", default="", metavar="RUN_DIR",
+        help="also append an `analysis` event with the verdict to this "
+        "run directory's events.jsonl, so `summarize` renders the "
+        "last analysis result alongside the run",
+    )
+    args = ap.parse_args(argv)
+
+    from bdbnn_tpu.analysis import render_report, run_check
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    report = run_check(
+        root,
+        checkers=args.checkers or None,
+        baseline_path=args.baseline or None,
+    )
+    from bdbnn_tpu.obs.events import jsonsafe
+
+    report = jsonsafe(report)
+    print(
+        json.dumps(report, indent=2, sort_keys=True)
+        if args.json else render_report(report)
+    )
+    if args.events_into:
+        from bdbnn_tpu.obs.events import EventWriter
+
+        ev = EventWriter(args.events_into)
+        ev.emit(
+            "analysis",
+            verdict=report["verdict"],
+            checkers=report["checkers"],
+            files_scanned=report["files_scanned"],
+            findings=report["counts"]["findings"],
+            suppressed=report["counts"]["suppressed"],
+            by_checker=report["counts"]["by_checker"],
+            records=[f["record"] for f in report["findings"]],
+        )
+        ev.close()
+    return 0 if report["verdict"] == "clean" else 3
+
+
 def registry_main(argv) -> int:
     """``python -m bdbnn_tpu.cli registry {publish,list,resolve} ...``
     — manage a versioned artifact registry (serve/registry.py): the
@@ -1157,6 +1241,7 @@ _SUBCOMMANDS = {
     "serve-bench": serve_bench_main,
     "serve-http": serve_http_main,
     "registry": registry_main,
+    "check": check_main,
 }
 
 

@@ -152,11 +152,22 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
   reconciliation identity and tail-exemplar table land in the v4 SLO
   verdict's ``attribution`` block, not in events
 
-New kinds must be registered in :data:`KNOWN_KINDS` —
-``tests/test_events_schema.py`` AST-scans every ``.emit(`` call site in
-the package against it, and round-trips each kind's payload through a
-strict RFC-8259 parser, so an unregistered kind (or one smuggling NaN)
-fails CI instead of silently corrupting the channel.
+The static analyzer adds one more:
+
+- ``analysis``    — one ``check`` CLI run's verdict (bdbnn_tpu/
+  analysis/ via ``check --events-into RUN_DIR``): checkers run, files
+  scanned, open/suppressed finding counts, per-checker counts and the
+  open finding records — so ``summarize`` can render the last static-
+  analysis verdict alongside a run's telemetry
+
+New kinds must be registered in :data:`KNOWN_KINDS` — the
+``event-schema`` checker (bdbnn_tpu/analysis/eventschema.py, wrapped
+as a tier-1 test by ``tests/test_events_schema.py``) AST-scans every
+``.emit(`` call site in the package against it, requires every
+registered kind to be documented here and to keep a live call site,
+and the test round-trips each kind's payload through a strict RFC-8259
+parser — so an unregistered kind (or one smuggling NaN) fails CI
+instead of silently corrupting the channel.
 
 **Rotation.** ``events.jsonl`` is append-only and a multi-day run's
 interval events would otherwise grow it without bound. The writer takes
@@ -207,6 +218,7 @@ KNOWN_KINDS = frozenset(
         "rtrace",
         "canary",
         "shadow",
+        "analysis",
     }
 )
 

@@ -252,20 +252,23 @@ class RequestTracer:
         # stamps perf_counter deltas against the same timeline
         self.t_base = time.perf_counter()
         self._lock = threading.Lock()
-        self._seq = 0
+        self._seq = 0  # guarded-by: _lock
+        # guarded-by: _lock: finished, aborted, sampled
         self.finished = 0
         self.aborted = 0
         self.sampled = 0
         # rolling sample windows (bounded deques — C-implemented
         # eviction keeps the request-path cost flat):
         # {priority: {stage: deque[ms]}} plus the end-to-end window
-        self._stage_win: Dict[int, Dict[str, Any]] = {}
-        self._e2e_win: Dict[int, Any] = {}
+        self._stage_win: Dict[int, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._e2e_win: Dict[int, Any] = {}  # guarded-by: _lock
         # slowest-K min-heap per priority: (total_ms, seq, trace) —
         # the trace object itself; waterfalls render at REPORT time,
         # never in the request path
-        self._tail: Dict[int, List[tuple]] = {}
+        self._tail: Dict[int, List[tuple]] = {}  # guarded-by: _lock
         # reconciliation accumulators over EVERY finished request
+        # guarded-by: _lock: _recon_n, _recon_sum_err_ms,
+        # guarded-by: _lock: _recon_sum_err_pct, _recon_max_err_pct
         self._recon_n = 0
         self._recon_sum_err_ms = 0.0
         self._recon_sum_err_pct = 0.0
@@ -406,7 +409,7 @@ class RequestTracer:
             "n": len(s),
         }
 
-    def _merged_stage_windows(self) -> Dict[str, List[float]]:
+    def _merged_stage_windows(self) -> Dict[str, List[float]]:  # requires-lock: _lock
         merged: Dict[str, List[float]] = {}
         for wins in self._stage_win.values():
             for stage, win in wins.items():

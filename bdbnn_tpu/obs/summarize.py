@@ -449,6 +449,20 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     resilience = _resilience(manifest, events)
     health = _health(events)
     serving = _serving(events)
+    # the LAST static-analysis verdict recorded on this timeline
+    # (`check --events-into RUN_DIR`, bdbnn_tpu/analysis/)
+    analysis_ev = next(
+        (e for e in reversed(events) if e.get("kind") == "analysis"),
+        None,
+    )
+    analysis = (
+        {
+            k: analysis_ev.get(k)
+            for k in ("verdict", "checkers", "files_scanned",
+                      "findings", "suppressed", "by_checker")
+        }
+        if analysis_ev is not None else None
+    )
 
     summary: Dict[str, Any] = {
         "run_dir": run_dir,
@@ -477,6 +491,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
         "resilience": resilience,
         "health": health,
         "serving": serving,
+        "analysis": analysis,
         "nonfinite_intervals": len(nonfinite),
     }
     # strict JSON out the other end too: a warn-policy run's NaN
@@ -534,6 +549,14 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                 )
         else:
             lines.append("health: monitored, no alerts")
+    if analysis:
+        lines.append(
+            f"static analysis: {str(analysis.get('verdict')).upper()} "
+            f"({analysis.get('findings')} open, "
+            f"{analysis.get('suppressed')} suppressed over "
+            f"{analysis.get('files_scanned')} files; "
+            + ", ".join(analysis.get("checkers") or []) + ")"
+        )
     if serving:
         for ex in serving["exports"]:
             lines.append(

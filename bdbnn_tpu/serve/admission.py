@@ -134,15 +134,15 @@ class AdmissionController:
                     f"got {rate}:{burst}"
                 )
         self._clock = clock
-        self._buckets: Dict[str, TokenBucket] = {}
+        self._buckets: Dict[str, TokenBucket] = {}  # guarded-by: _lock
         self._draining = threading.Event()
         self._lock = threading.Lock()
         # per-tenant accounting: every decision and every downstream
         # disposition the front end reports back lands here, so the
         # verdict's per-tenant table comes from ONE place
-        self._counts: Dict[str, Dict[str, int]] = {}
+        self._counts: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
 
-    def _tenant_counts(self, tenant: str) -> Dict[str, int]:
+    def _tenant_counts(self, tenant: str) -> Dict[str, int]:  # requires-lock: _lock
         return self._counts.setdefault(
             tenant,
             {"admitted": 0, "over_quota": 0, "shed": 0, "completed": 0,

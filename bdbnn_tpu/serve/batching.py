@@ -117,7 +117,7 @@ class MicroBatcher:
         self.priorities = int(priorities)
         # one bounded deque per priority class, 0 drained first; all
         # guarded by _lock (the Condition's lock)
-        self._qs: List[deque] = [deque() for _ in range(self.priorities)]
+        self._qs: List[deque] = [deque() for _ in range(self.priorities)]  # guarded-by: _lock
         # latched drain flag (resilience.py pattern): set once, observed
         # by the worker at batch boundaries and by submit immediately
         self._draining = threading.Event()
@@ -126,7 +126,12 @@ class MicroBatcher:
         # set by the WORKER, under _lock, after its final queue sweep:
         # once True no request can enter a queue, so no accepted
         # Future can ever be left unresolved (see _worker/submit)
-        self._dead = False
+        self._dead = False  # guarded-by: _lock
+        # shared counters, written by submit / the worker / settle
+        # callbacks and snapshotted by stats():
+        # guarded-by: _lock: shed, completed, batches, occupancy_sum,
+        # guarded-by: _lock: max_queue_depth_seen, _shed_p, _completed_p,
+        # guarded-by: _lock: _max_depth_p, _occupancy_sum_p
         self.shed = 0
         self.completed = 0
         self.batches = 0
@@ -149,7 +154,7 @@ class MicroBatcher:
         # set it to ~2x the replica count: one batch executing + one
         # queued per replica, bounding priority inversion to what is
         # already dispatched.
-        self._pending_async = 0
+        self._pending_async = 0  # guarded-by: _lock
         self.max_pending_batches = max_pending_batches
         self._thread = threading.Thread(
             target=self._worker, name="micro-batcher", daemon=True
@@ -267,7 +272,7 @@ class MicroBatcher:
 
     # -- worker side ---------------------------------------------------
 
-    def _pop_highest(self) -> Optional[_Request]:
+    def _pop_highest(self) -> Optional[_Request]:  # requires-lock: _lock
         """Pop the oldest request of the HIGHEST nonempty class (strict
         priority: class 1 is only served when class 0 is empty). Caller
         holds ``_lock``."""

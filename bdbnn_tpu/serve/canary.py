@@ -214,6 +214,13 @@ class CanaryMonitor:
         self.priorities = max(int(priorities), 1)
         self.on_event = on_event
         self._lock = threading.Lock()
+        # episode identity + every rolling window and accumulator the
+        # concurrent feeds write:
+        # guarded-by: _lock: active, version_from, version_to, fraction,
+        # guarded-by: _lock: canary_replicas, _lat, _disp, _comp, served,
+        # guarded-by: _lock: drift_n, drift_max, evaluations, _clean_streak,
+        # guarded-by: _lock: decision, trigger, _last_detectors, _t_armed,
+        # guarded-by: _lock: _t_decided, _states
         self.active = False
         self.version_from: Optional[str] = None
         self.version_to: Optional[str] = None
@@ -221,7 +228,7 @@ class CanaryMonitor:
         self.canary_replicas: Optional[List[int]] = None
         self._reset()
 
-    def _reset(self) -> None:
+    def _reset(self) -> None:  # requires-lock: _lock
         cfg = self.cfg
         self._lat: Dict[Any, Any] = {}
         self._disp: Dict[str, Any] = {
@@ -288,7 +295,7 @@ class CanaryMonitor:
 
     # -- feeds ---------------------------------------------------------
 
-    def _cohort(self, version: Optional[str]) -> Optional[str]:
+    def _cohort(self, version: Optional[str]) -> Optional[str]:  # requires-lock: _lock
         if version is None:
             return None
         return CANARY if str(version) == self.version_to else INCUMBENT
@@ -347,12 +354,13 @@ class CanaryMonitor:
 
     # -- judgment ------------------------------------------------------
 
-    def _detector_rows(
+    def _detector_rows(  # requires-lock: _lock
         self, pool_counters: Optional[Dict[str, Dict[str, Any]]]
     ) -> Dict[str, Dict[str, Any]]:
         """One evidence row per detector: value, threshold, breach,
         eligible, recovered (the hysteresis re-arm signal) + the raw
-        window evidence. Caller holds the lock."""
+        window evidence. Caller holds the lock
+        (``# requires-lock: _lock`` on the def line above)."""
         cfg = self.cfg
         rows: Dict[str, Dict[str, Any]] = {}
         ratios: Dict[int, float] = {}

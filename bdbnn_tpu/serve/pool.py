@@ -119,28 +119,32 @@ class Replica:
         self.device = str(device)
         self.version = str(version)
         self.max_queue_batches = int(max_queue_batches)
-        self._runner = runner
+        self._runner = runner  # guarded-by: _lock
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._q: deque = deque()
-        self.state = READY
+        self._q: deque = deque()  # guarded-by: _lock
+        self.state = READY  # guarded-by: _lock
         # canary cohort membership (serve/canary.py): while a canary
         # stage is active the dispatcher routes the canary traffic
         # fraction to replicas with this flag set; a health restart
         # preserves it (the runner — and therefore the version — is
         # unchanged by a restart)
-        self.canary = False
+        self.canary = False  # guarded-by: _lock
         # monotonic timestamp of the batch currently executing (None =
         # idle) — the wedge detector's heartbeat
-        self.busy_since: Optional[float] = None
+        self.busy_since: Optional[float] = None  # guarded-by: _lock
         # generation tag: a restart bumps it; a worker observing a
         # newer generation retires itself instead of double-consuming
-        self._gen = 0
+        self._gen = 0  # guarded-by: _lock
+        # guarded-by: _lock: version, batches, completed, restarts
         self.batches = 0
         self.completed = 0
         self.restarts = 0
-        self._stopping = False
-        self._thread: Optional[threading.Thread] = None
+        self._stopping = False  # guarded-by: _lock
+        # declared guarded so the checker audits every new touch point;
+        # the start_worker writes are single-writer by construction
+        # (baselined with justification in analysis-baseline.txt)
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
         # superseded worker threads that were still alive at restart: a
         # wedged generation may hold an accepted batch Future, and
         # stop() must wait it out (or report unclean) — dropping the
@@ -376,28 +380,33 @@ class ReplicaPool:
         # reporting and the verdict's request ledger read the latter so
         # they never mix units with the front batcher's per-request
         # counters
+        # guarded-by: _lock: shed, shed_requests, dispatched
         self.shed = 0
         self.shed_requests = 0
         self.dispatched = 0
-        self.completed_by_version: Dict[str, int] = {}
-        self.failed_by_version: Dict[str, int] = {}
+        self.completed_by_version: Dict[str, int] = {}  # guarded-by: _lock
+        self.failed_by_version: Dict[str, int] = {}  # guarded-by: _lock
         self._swap_lock = threading.Lock()
-        self._swap_status: Dict[str, Any] = {"state": SWAP_IDLE}
+        self._swap_status: Dict[str, Any] = {"state": SWAP_IDLE}  # guarded-by: _lock
         # canary stage (serve/canary.py): non-None while a canary is
         # observing — {"seed", "fraction", "version_to", "monitor",
         # "shadow_every"}; submit snapshots it once per batch (a plain
         # attribute read — the non-canary dispatch path pays one `is
         # None` check and nothing else)
         self._canary: Optional[Dict[str, Any]] = None
-        self._canary_seq = 0
-        self._cohort_counts: Optional[Dict[str, Dict[str, int]]] = None
+        self._canary_seq = 0  # guarded-by: _lock
+        self._cohort_counts: Optional[Dict[str, Dict[str, int]]] = None  # guarded-by: _lock
         # shadow comparator: mirror pairs queue + the thread that diffs
-        # them OFF the hot path (a worker's done-callback only appends)
+        # them OFF the hot path (a worker's done-callback only appends).
+        # _shadow_queue is deliberately UNguarded: deque append/popleft
+        # are atomic under the GIL and the queue is a single-producer/
+        # single-consumer channel — annotating it would demand a lock
+        # the hot-path callback does not need.
         self._shadow_queue: deque = deque()
         self._shadow_wake = threading.Event()
         self._shadow_stop = threading.Event()
         self._shadow_thread: Optional[threading.Thread] = None
-        self._shadow_stats = {"mirrored": 0, "skipped": 0, "failed": 0}
+        self._shadow_stats = {"mirrored": 0, "skipped": 0, "failed": 0}  # guarded-by: _lock
         # the factory needs the REAL device objects (jax.Device on the
         # engine path); replica snapshots carry only the string label
         self._device_objs: List[Any] = list(devices)
@@ -620,7 +629,10 @@ class ReplicaPool:
         shadow.future.add_done_callback(_arm)
 
     def _start_shadow(self, monitor) -> None:
-        self._shadow_stats = {"mirrored": 0, "skipped": 0, "failed": 0}
+        # the stats pump may be snapshotting stats() concurrently with
+        # a rollout arming the probe — the reset goes under the lock
+        with self._lock:
+            self._shadow_stats = {"mirrored": 0, "skipped": 0, "failed": 0}
         self._shadow_queue.clear()
         self._shadow_stop.clear()
         self._shadow_thread = threading.Thread(
@@ -751,8 +763,11 @@ class ReplicaPool:
                         "queue full" if placed is False
                         else "no healthy replica"
                     ))
-        # fresh generation + worker; the old thread retires itself
-        r.restarts += 1
+        # fresh generation + worker; the old thread retires itself.
+        # restarts is a counter snapshot() reads concurrently — the
+        # increment takes the replica lock like every other counter
+        with r._lock:
+            r.restarts += 1
         r.start_worker()
         with r._lock:
             # re-read under the lock, and overwrite ONLY our own
@@ -1268,7 +1283,10 @@ class ReplicaPool:
             clean = r.stop(
                 timeout=max(deadline - time.monotonic(), 0.1)
             ) and clean
-            r.state = STOPPED
+            # an unclean stop leaves a worker alive reading state under
+            # its lock (try_enqueue) — the terminal write takes it too
+            with r._lock:
+                r.state = STOPPED
         # belt and braces: a worker that failed to stop in time may
         # leave queued work — answer it explicitly, never silently
         for r in self.replicas:
@@ -1350,13 +1368,13 @@ class PoolAdmin:
         self.shed_counter = shed_counter
         self.canary = canary
         self._lock = threading.Lock()
-        self._thread: Optional[threading.Thread] = None
-        self._last_swap: Optional[Dict[str, Any]] = None
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
+        self._last_swap: Optional[Dict[str, Any]] = None  # guarded-by: _lock
         # the target of an ACCEPTED start_swap, recorded before the
         # rollout thread runs: a swap still in flight (or wedged) at
         # verdict time must report an honest not-performed block, not
         # a null that skips every zero-downtime gate
-        self._requested: Optional[str] = None
+        self._requested: Optional[str] = None  # guarded-by: _lock
 
     def replicas(self) -> Dict[str, Any]:
         return self.pool.stats()
@@ -1632,7 +1650,9 @@ class ResidentModelCache:
         self.on_event = on_event
         self._lock = threading.Lock()
         # insertion/refresh order IS the LRU order (oldest first)
-        self._engines: "dict[str, Any]" = {}
+        self._engines: "dict[str, Any]" = {}  # guarded-by: _lock
+        # guarded-by: _lock: hits, misses, evictions, loads,
+        # guarded-by: _lock: load_seconds, resident_bytes, dense_equiv_bytes
         self.hits = 0
         self.misses = 0
         self.evictions = 0

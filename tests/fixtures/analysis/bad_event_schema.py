@@ -1,0 +1,19 @@
+"""Seeded-bad fixture: fires EXACTLY `event-schema` (one finding).
+
+Carries its own registry so the checker runs standalone: the one
+registered kind ``good`` is documented here and has a call site; the
+``rogue`` emit below is unregistered. No locks, no jit, no
+serve-metric flattener.
+"""
+
+KNOWN_KINDS = frozenset({"good"})
+
+
+class Emitter:
+    def emit(self, kind, **fields):
+        return {"kind": kind, **fields}
+
+
+def run(ev: Emitter):
+    ev.emit("good", value=1)
+    ev.emit("rogue", value=2)  # BAD: kind not in KNOWN_KINDS

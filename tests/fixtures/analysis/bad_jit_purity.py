@@ -1,0 +1,21 @@
+"""Seeded-bad fixture: fires EXACTLY `jit-purity` (one finding).
+
+A jitted function reaches a host-clock call through a helper — the
+closure (not just the root's own body) must catch it. No guarded-by
+annotations, no event emits, no serve-metric flattener, so no other
+checker can fire on this file.
+"""
+
+import time
+
+import jax
+
+
+def _leaky_helper(x):
+    t = time.perf_counter()  # BAD: host clock inside traced code
+    return x * t
+
+
+@jax.jit
+def bad_step(x):
+    return _leaky_helper(x) + 1

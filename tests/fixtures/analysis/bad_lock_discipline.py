@@ -1,0 +1,22 @@
+"""Seeded-bad fixture: fires EXACTLY `lock-discipline` (one finding).
+
+A guarded counter read-modify-written off the lock — the shape of the
+unguarded-counter races the checker exists for. No jit roots, no event
+emits, no serve-metric flattener, so no other checker can fire on this
+file (the per-detector discipline tests/test_analysis.py pins).
+"""
+
+import threading
+
+
+class BadCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # guarded-by: _lock
+
+    def bump_guarded(self):
+        with self._lock:
+            self.count += 1
+
+    def bump_racy(self):
+        self.count += 1  # BAD: read-modify-write outside the lock

@@ -1,0 +1,17 @@
+"""Seeded-bad fixture: fires EXACTLY `verdict-coherence` (one finding).
+
+A compare-shaped module whose METRIC_SPECS judges a serve metric the
+``_serve_metrics`` flattener never produces — the literal-drift class
+the checker exists for. No locks, no jit, no event registry.
+"""
+
+METRIC_SPECS = (
+    ("serve_p99_ms", "lower", "rel"),
+    ("serve_ghost_metric", "lower", "rel"),  # BAD: never produced
+)
+
+
+def _serve_metrics(verdict):
+    out = {}
+    out["serve_p99_ms"] = verdict.get("p99_ms")
+    return out

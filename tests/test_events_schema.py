@@ -4,9 +4,12 @@ Two invariants that keep ``events.jsonl`` machine-readable forever:
 
 1. **Registered kinds.** Every ``*.emit(...)`` call site in the package
    (plus the bench/profile harnesses) passes a LITERAL kind string that
-   is registered in ``events.KNOWN_KINDS`` — a new event kind added
-   without registration fails here, so the docs/registry can't drift
-   from the code.
+   is registered in ``events.KNOWN_KINDS``, every registered kind is
+   documented in the module docstring, and every registered kind keeps
+   a live call site. The AST scan that enforces this was born here and
+   now lives in the static-analysis package
+   (``bdbnn_tpu/analysis/eventschema.py``, the ``event-schema``
+   checker) — this test is the thin tier-1 wrapper over it.
 
 2. **Strict RFC 8259.** Whatever a call site passes — NaN/Inf floats,
    numpy scalars, nested dicts of them — the emitted line round-trips
@@ -16,14 +19,14 @@ Two invariants that keep ``events.jsonl`` machine-readable forever:
    warn-policy run's telemetry.
 """
 
-import ast
-import glob
 import json
 import os
 
 import numpy as np
 import pytest
 
+from bdbnn_tpu.analysis.core import discover_files
+from bdbnn_tpu.analysis.eventschema import scan_events
 from bdbnn_tpu.obs.events import (
     KNOWN_KINDS,
     EventWriter,
@@ -34,83 +37,45 @@ from bdbnn_tpu.obs.events import (
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # everything that writes events: the package, plus the root-level
-# harnesses that share the channel
-SCANNED = sorted(
-    glob.glob(os.path.join(REPO, "bdbnn_tpu", "**", "*.py"), recursive=True)
-) + [os.path.join(REPO, "bench.py"), os.path.join(REPO, "profile_r05.py")]
-
-
-def _emit_calls(path):
-    """(lineno, first-arg AST node) for every ``<obj>.emit(...)`` or
-    ``<obj>._emit(...)`` call — the latter are the telemetry-relay
-    wrappers (serve/pool.py, serve/canary.py) that forward
-    ``(kind, **fields)`` to an injected ``on_event`` hook, which the
-    orchestrations wire to ``EventWriter.emit``; their literal kinds
-    must be registered exactly like direct emits, or the canary/shadow
-    channel could drift unregistered.
-
-    ``EventWriter.emit``'s own definition isn't a call; dict ``.items``
-    etc. don't match the attribute names."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ("emit", "_emit")
-        ):
-            # ProgressLog.emit(step, parts) takes an int first — only
-            # event emits pass a string literal or anything else; the
-            # literal-kind assertion below separates them
-            out.append((node.lineno, node.args[0] if node.args else None))
-    return out
+# harnesses that share the channel (the analysis package's default
+# scan set is exactly this)
+SCANNED = discover_files(REPO)
 
 
 class TestEmitCallSites:
-    def test_every_emit_kind_is_registered(self):
-        """Every event-channel emit passes a literal, registered kind."""
-        unregistered = []
-        found = set()
-        for path in SCANNED:
-            for lineno, arg in _emit_calls(path):
-                if not isinstance(arg, ast.Constant) or not isinstance(
-                    arg.value, str
-                ):
-                    # not the event channel (ProgressLog.emit's first
-                    # arg is a step index; **info-style relays are
-                    # covered by the registry test on their kind field)
-                    continue
-                found.add(arg.value)
-                if arg.value not in KNOWN_KINDS:
-                    unregistered.append(
-                        f"{os.path.relpath(path, REPO)}:{lineno}: "
-                        f"emit({arg.value!r})"
-                    )
-        assert not unregistered, (
-            "event kinds missing from obs.events.KNOWN_KINDS:\n"
-            + "\n".join(unregistered)
-        )
-        # the scan actually saw the package's core kinds (guards
-        # against the AST walk silently matching nothing) — including
-        # the four resilience kinds, the two health-monitor kinds, the
-        # two serving kinds (serve/export.py, serve/loadgen.py), the
-        # two network-front-end kinds (serve/http.py) and the two
-        # replica-pool kinds (serve/http.py's replica heartbeat + the
-        # swap trigger), which must keep real call sites
-        # ... and the request-path tracing kind (serve/http.py +
-        # serve/loadgen.py sampled waterfalls and stats heartbeats)
-        # ... and the canary-rollout kinds (serve/canary.py monitor
-        # evaluations/decisions + serve/pool.py shadow-mirror probe)
+    """Thin wrapper over the ``event-schema`` checker: the scan logic
+    lives in bdbnn_tpu/analysis/eventschema.py (where the ``check``
+    CLI also runs it); this test keeps it a named tier-1 gate and pins
+    the historical found-set floor."""
+
+    def test_event_schema_checker_clean(self):
+        """No unregistered emit kinds, no undocumented registered
+        kinds, no dead registry entries — over the package + the
+        bench/profile harnesses. ``_emit`` relay wrappers
+        (serve/pool.py, serve/canary.py) are scanned exactly like
+        direct emits."""
+        findings, _found = scan_events(REPO, SCANNED)
+        assert findings == [], "\n".join(f.record for f in findings)
+
+    def test_found_set_floor(self):
+        """The scan actually saw the package's core kinds (guards
+        against the AST walk silently matching nothing) — the training
+        kinds, the four resilience kinds, the health-monitor kinds,
+        the serving/front-end/replica-pool kinds, the request-tracing
+        and canary kinds, and the static analyzer's own ``analysis``
+        kind (the `check --events-into` emit in cli.py)."""
+        _findings, found = scan_events(REPO, SCANNED)
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
                 "alert", "health", "export", "serve",
                 "http", "admission", "replica", "swap",
-                "rtrace", "canary", "shadow"} <= found
+                "rtrace", "canary", "shadow", "analysis"} <= found
 
     def test_registry_matches_docs(self):
-        """KNOWN_KINDS and the events.py module docstring stay in sync."""
+        """KNOWN_KINDS and the events.py module docstring stay in sync
+        (also enforced by the checker; kept as a direct assertion so a
+        failure names the kind)."""
         import bdbnn_tpu.obs.events as ev
 
         for kind in KNOWN_KINDS:
@@ -579,6 +544,45 @@ class TestStrictRfc8259:
         # the emit() return values match what was written
         assert c["ede_k"] is None and p["signum"] == 15
         assert r["topology_to"]["devices"] == 8
+
+    def test_analysis_kind_payload_roundtrips(self, tmp_path):
+        """The static analyzer's ``analysis`` payload shape (cli.py
+        ``check --events-into``) with adversarial values in the numeric
+        slots: numpy counters must unwrap, a NaN smuggled into a count
+        must land as null, and the by_checker dict + finding-record
+        list must survive strict parsing."""
+        ev = EventWriter(str(tmp_path))
+        a = ev.emit(
+            "analysis",
+            verdict="findings",
+            checkers=["lock-discipline", "jit-purity",
+                      "event-schema", "verdict-coherence"],
+            files_scanned=np.int64(65),
+            findings=np.int64(2),
+            suppressed=1,
+            by_checker={
+                "lock-discipline": np.int64(2),
+                "jit-purity": 0,
+                "event-schema": np.int64(0),
+                "verdict-coherence": float("nan"),
+            },
+            records=[
+                "bdbnn_tpu/serve/pool.py:181:lock-discipline:write of "
+                "guarded attribute self._thread outside "
+                "'with self._lock'",
+            ],
+        )
+        ev.close()
+        with open(ev.path) as f:
+            rec = self._strict(f.read().strip())
+        assert rec["kind"] == "analysis"
+        assert rec["files_scanned"] == 65
+        assert isinstance(rec["files_scanned"], int)
+        assert rec["by_checker"]["lock-discipline"] == 2
+        assert rec["by_checker"]["verdict-coherence"] is None  # NaN
+        assert rec["records"][0].endswith("'with self._lock'")
+        # the emit() return value matches what was written
+        assert a["findings"] == 2 and a["suppressed"] == 1
 
     def test_health_kind_payloads_roundtrip(self, tmp_path):
         """The real alert/health payload shapes the monitor emits
