@@ -966,6 +966,13 @@ def serve_http_main(argv) -> int:
         help="slowest requests per priority kept as tail exemplars in "
         "the verdict's attribution block (default 5)",
     )
+    ap.add_argument(
+        "--server-id", default="",
+        help="stable host id advertised on /healthz//statsz and "
+        "stamped into 200 responses (served_by) — what a fleet "
+        "router's per-host ledger cross-checks against (default: "
+        "none; responses unchanged)",
+    )
     args = ap.parse_args(argv)
 
     _force_jax_platforms()
@@ -1016,6 +1023,7 @@ def serve_http_main(argv) -> int:
         rtrace=args.rtrace,
         rtrace_sample_every=args.rtrace_sample_every,
         rtrace_tail_k=args.rtrace_tail_k,
+        server_id=args.server_id,
     )
     result = run_serve_http(cfg)
     print(json.dumps(result["verdict"], indent=2, sort_keys=True))
@@ -1091,6 +1099,215 @@ def serve_http_main(argv) -> int:
     if slo is not None and not slo.get("met"):
         print(
             f"[serve-http] SLO MISSED: priority-0 p99 "
+            f"{slo.get('p99_ms_priority0')}ms > target "
+            f"{slo.get('p99_ms_target_priority0')}ms",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+def serve_fleet_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli serve-fleet --hosts H:P H:P ...`` —
+    the cross-host fleet router (serve/fleet.py): spread traffic over
+    N running serve-http hosts by health and occupancy, with per-host
+    health probes (warmup→debounce→hysteresis), bounded
+    retry-with-backoff on host failures (an accepted request is
+    answered by a peer, never dropped), the explicit load-shed
+    taxonomy relayed end-to-end, digest-verified registry replication
+    and host-by-host fleet blue/green. With ``--scenario`` the
+    traffic-shaped socket load generator drives the ROUTER and the v6
+    verdict carries the ``fleet`` block whose per-host ledgers must
+    sum to the client totals. Stdlib-only: never initializes a JAX
+    backend (the hosts own the engines)."""
+    import json
+
+    from bdbnn_tpu.configs.config import ServeFleetConfig
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli serve-fleet",
+        description="Route traffic across a fleet of serve-http hosts "
+        "by health and occupancy, with retry/backoff host-failure "
+        "tolerance and fleet-consistent verdicts.",
+    )
+    ap.add_argument(
+        "artifact", nargs="?", default="",
+        help="export artifact dir (scenario mode reads image_size "
+        "from its artifact.json to shape request bodies; no weights "
+        "are loaded)",
+    )
+    ap.add_argument(
+        "--hosts", nargs="+", required=True, metavar="HOST:PORT",
+        help="backend serve-http hosts to route across",
+    )
+    ap.add_argument("--log-path", default="serve_fleet_log")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument(
+        "--port", type=int, default=0,
+        help="router bind port (default 0 = kernel-assigned)",
+    )
+    ap.add_argument(
+        "--priorities", type=int, default=3,
+        help="x-priority classes the router ledgers by (must match "
+        "the hosts')",
+    )
+    ap.add_argument(
+        "--probe-interval-s", type=float, default=0.25,
+        help="health-probe cadence per host (default 0.25)",
+    )
+    ap.add_argument("--probe-timeout-s", type=float, default=1.0)
+    ap.add_argument(
+        "--health-warmup", type=int, default=0,
+        help="probes never judged after a host joins (default 0)",
+    )
+    ap.add_argument(
+        "--health-debounce", type=int, default=2,
+        help="consecutive probe failures before a host is declared "
+        "dead (default 2)",
+    )
+    ap.add_argument(
+        "--max-attempts", type=int, default=3,
+        help="distinct hosts a request is tried on across transport "
+        "failures before the router sheds it explicitly (default 3)",
+    )
+    ap.add_argument("--backoff-base-ms", type=float, default=25.0)
+    ap.add_argument("--backoff-cap-ms", type=float, default=250.0)
+    ap.add_argument("--proxy-timeout-s", type=float, default=60.0)
+    ap.add_argument(
+        "--ready-timeout-s", type=float, default=60.0,
+        help="how long to wait for at least one host to probe ready",
+    )
+    ap.add_argument(
+        "--scenario", default="",
+        choices=["", "poisson", "diurnal", "flash_crowd", "heavy_tail",
+                 "slow_client"],
+        help="bench mode: drive this arrival process against the "
+        "ROUTER, then drain and report (default: route until SIGTERM)",
+    )
+    ap.add_argument("--rate", type=float, default=100.0)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--flash-factor", type=float, default=8.0)
+    ap.add_argument("--diurnal-amp", type=float, default=0.8)
+    ap.add_argument("--heavy-sigma", type=float, default=1.5)
+    ap.add_argument("--slow-fraction", type=float, default=0.2)
+    ap.add_argument(
+        "--priority-weights", type=float, nargs="+", default=[],
+    )
+    ap.add_argument(
+        "--tenants", nargs="+", default=["tenant-a", "tenant-b"],
+    )
+    ap.add_argument(
+        "--tenant-weights", type=float, nargs="+", default=[],
+    )
+    ap.add_argument("--slo-p99-ms", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--out", default="", help="also write the SLO verdict JSON here",
+    )
+    ap.add_argument("--stats-interval-s", type=float, default=1.0)
+    ap.add_argument("--events-max-mb", type=float, default=256.0)
+    ap.add_argument(
+        "--registry", default="",
+        help="PRIMARY artifact registry fleet rollouts pull from",
+    )
+    ap.add_argument(
+        "--host-registries", nargs="+", default=[],
+        metavar="DIR",
+        help="per-host registry roots (one per --hosts entry, in "
+        "order) the fleet swap replicates versions into by "
+        "digest-verified pull",
+    )
+    ap.add_argument(
+        "--swap-to", default="",
+        help="fleet blue/green target: a registry version (vNNNN, "
+        "with --registry) or an artifact dir",
+    )
+    ap.add_argument(
+        "--swap-at", type=float, default=0.0,
+        help="with --scenario: fire the fleet swap after this "
+        "fraction of the schedule has been offered (0 = no scheduled "
+        "swap; POST /fleet/swap still works)",
+    )
+    ap.add_argument("--swap-host-timeout-s", type=float, default=120.0)
+    args = ap.parse_args(argv)
+
+    from bdbnn_tpu.serve.fleet import run_serve_fleet
+
+    cfg = ServeFleetConfig(
+        hosts=tuple(args.hosts),
+        artifact=args.artifact,
+        log_path=args.log_path,
+        host=args.host,
+        port=args.port,
+        priorities=args.priorities,
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        health_warmup=args.health_warmup,
+        health_debounce=args.health_debounce,
+        max_attempts=args.max_attempts,
+        backoff_base_ms=args.backoff_base_ms,
+        backoff_cap_ms=args.backoff_cap_ms,
+        proxy_timeout_s=args.proxy_timeout_s,
+        ready_timeout_s=args.ready_timeout_s,
+        scenario=args.scenario,
+        rate=args.rate,
+        requests=args.requests,
+        concurrency=args.concurrency,
+        flash_factor=args.flash_factor,
+        diurnal_amp=args.diurnal_amp,
+        heavy_sigma=args.heavy_sigma,
+        slow_fraction=args.slow_fraction,
+        priority_weights=tuple(args.priority_weights),
+        tenants=tuple(args.tenants),
+        tenant_weights=tuple(args.tenant_weights),
+        slo_p99_ms=args.slo_p99_ms,
+        seed=args.seed,
+        out=args.out,
+        stats_interval_s=args.stats_interval_s,
+        events_max_mb=args.events_max_mb,
+        registry=args.registry,
+        host_registries=tuple(args.host_registries),
+        swap_to=args.swap_to,
+        swap_at=args.swap_at,
+        swap_host_timeout_s=args.swap_host_timeout_s,
+    )
+    result = run_serve_fleet(cfg)
+    print(json.dumps(result["verdict"], indent=2, sort_keys=True))
+    print(
+        f"[serve-fleet] run dir: {result['run_dir']} "
+        f"(routed on {result['host']}:{result['port']})",
+        file=sys.stderr,
+    )
+    fleet = result["verdict"].get("fleet") or {}
+    dropped = fleet.get("dropped") or 0
+    if dropped:
+        print(
+            f"[serve-fleet] {dropped} request(s) got NO response "
+            "(dropped) — the fleet drain contract was violated",
+            file=sys.stderr,
+        )
+        return 1
+    if fleet.get("ledger_consistent") is False:
+        print(
+            "[serve-fleet] per-host ledgers do NOT sum to the client "
+            "totals — fleet accounting is torn; see the verdict's "
+            "fleet block",
+            file=sys.stderr,
+        )
+        return 1
+    swap = fleet.get("swap")
+    if swap is not None and swap.get("state") not in (None, "done"):
+        print(
+            f"[serve-fleet] fleet swap ended in state "
+            f"{swap.get('state')}: {swap.get('error')}",
+            file=sys.stderr,
+        )
+        return 1
+    slo = result["verdict"].get("slo")
+    if slo is not None and not slo.get("met"):
+        print(
+            f"[serve-fleet] SLO MISSED: priority-0 p99 "
             f"{slo.get('p99_ms_priority0')}ms > target "
             f"{slo.get('p99_ms_target_priority0')}ms",
             file=sys.stderr,
@@ -1184,33 +1401,59 @@ def check_main(argv) -> int:
 
 
 def registry_main(argv) -> int:
-    """``python -m bdbnn_tpu.cli registry {publish,list,resolve} ...``
+    """``python -m bdbnn_tpu.cli registry {publish,list,resolve,pull}``
     — manage a versioned artifact registry (serve/registry.py): the
     store blue/green hot-swaps resolve their targets from. ``publish``
     copies an export artifact in as the next immutable version (its
     digest chain verified first); ``list`` prints the index;
-    ``resolve`` digest-verifies one version and prints its path. Reads
-    and writes files only; never initializes a JAX backend."""
+    ``resolve`` digest-verifies one version and prints its path;
+    ``pull --from REMOTE [VERSION]`` replicates versions from another
+    registry with the digest chain verified twice (the fleet's
+    replication primitive, drivable by hand). Reads and writes files
+    only; never initializes a JAX backend."""
     import json
 
     ap = argparse.ArgumentParser(
         prog="bdbnn_tpu.cli registry",
         description="Versioned artifact registry for serving rollouts.",
     )
-    ap.add_argument("action", choices=["publish", "list", "resolve"])
+    ap.add_argument(
+        "action", choices=["publish", "list", "resolve", "pull"],
+    )
     ap.add_argument(
         "target", nargs="?", default="",
         help="publish: the artifact dir; resolve: the version (vNNNN "
-        "or integer)",
+        "or integer); pull: an optional version (default: every "
+        "version absent locally)",
     )
     ap.add_argument(
         "-r", "--registry", required=True, help="registry root dir",
+    )
+    ap.add_argument(
+        "--from", dest="pull_from", default="",
+        help="pull: the REMOTE registry root to replicate from "
+        "(digest chain verified at the source and again on the "
+        "staged copy; a torn transfer leaves this registry untouched)",
     )
     args = ap.parse_args(argv)
 
     from bdbnn_tpu.serve.registry import ArtifactRegistry
 
     reg = ArtifactRegistry(args.registry)
+    if args.action == "pull":
+        if not args.pull_from:
+            ap.error("pull needs --from REMOTE_REGISTRY_DIR")
+        from bdbnn_tpu.serve.registry import parse_version
+
+        version = None
+        if args.target:
+            try:
+                version = parse_version(args.target)
+            except ValueError as e:
+                ap.error(str(e))
+        pulled = reg.pull(args.pull_from, version)
+        print(json.dumps(pulled, indent=2, sort_keys=True))
+        return 0
     if args.action == "publish":
         if not args.target:
             ap.error("publish needs the artifact dir to publish")
@@ -1240,6 +1483,7 @@ _SUBCOMMANDS = {
     "predict": predict_main,
     "serve-bench": serve_bench_main,
     "serve-http": serve_http_main,
+    "serve-fleet": serve_fleet_main,
     "registry": registry_main,
     "check": check_main,
 }

@@ -128,6 +128,19 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("serve_canary_rollbacks", "lower", "count"),
     ("serve_shadow_logit_drift_max", "lower", "count"),
     ("serve_canary_promote_s", "lower", "rel"),
+    # v6 fleet verdicts (serve/fleet.py): the summed-across-hosts
+    # dropped count is the zero-tolerance drain contract one topology
+    # level up — a fleet that lost even one request to a host failure
+    # is a regression no tolerance can wave through. The cross-host
+    # retry rate (retries per routed request, --tol-rel) catches a
+    # build that quietly started burning peer retries to hide a flaky
+    # host, and the per-host p99 spread (max/min host p99, --tol-rel)
+    # catches dispatch skew — one slow host hiding behind a healthy
+    # fleet aggregate. v1-v5 verdicts (no fleet block) leave all
+    # three None, so they skip cleanly in BOTH directions.
+    ("serve_fleet_dropped", "lower", "count"),
+    ("serve_fleet_retry_rate", "lower", "rel"),
+    ("serve_fleet_host_p99_spread", "lower", "rel"),
 )
 
 # serve-verdict field -> compare metric name (flat v1 aggregates)
@@ -203,6 +216,21 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
         (can or {}).get("shadow") or {}
     ).get("max_abs_drift")
     out["serve_canary_promote_s"] = (can or {}).get("promote_s")
+    # v6 fleet block (serve/fleet.py): the summed-across-hosts dropped
+    # count (None when no client observed the run — "not measured",
+    # never a fabricated 0), the cross-host retry rate and the
+    # per-host p99 spread. Absent block -> all None, so v1-v5
+    # verdicts skip the fleet gates cleanly.
+    fleet = verdict.get("fleet")
+    fleet_dropped = (fleet or {}).get("dropped")
+    out["serve_fleet_dropped"] = (
+        None if fleet is None or fleet_dropped is None
+        else int(fleet_dropped)
+    )
+    out["serve_fleet_retry_rate"] = (fleet or {}).get("retry_rate")
+    out["serve_fleet_host_p99_spread"] = (
+        (fleet or {}).get("host_p99_spread")
+    )
     swap = verdict.get("swap")
     if swap is None:
         out["serve_swap_dropped"] = None

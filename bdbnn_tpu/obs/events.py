@@ -140,6 +140,27 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
   ``drift`` = max abs element-wise difference — EXACTLY 0.0 between
   identical artifacts because packed inference is deterministic and
   bitwise-exact; any nonzero drift is a real defect)
+- ``fleet``       — the cross-host fleet router's lifecycle
+  (serve/fleet.py), disambiguated by ``phase``: ``start`` (router
+  bind host/port, the backend host set, scenario), ``ready`` (at
+  least one backend host probed ready — dispatch is possible),
+  ``probe`` (a host's health state TRANSITIONED:
+  state_from/state_to over the warming/ready/draining/dead machine —
+  steady-state probes emit nothing), ``proxy`` (one proxy attempt
+  against a host failed at the transport layer and the request is
+  being retried on a peer: host, cause connect/timeout/reset,
+  attempt index), ``pull`` (the fleet swap replicated a version into
+  one host registry by digest-verified pull), ``swap`` (the
+  host-by-host fleet rollout's trail: ``trigger`` at a schedule
+  position, one ``shifted`` per host as its own swap machine lands
+  terminal, then ``done``/``failed`` — the serialization is the
+  zero-overlap rollout contract), ``stats`` (the periodic per-host
+  table: state, occupancy, proxied/completed/relayed counters,
+  retries by cause — what ``watch`` renders as the fleet banner),
+  ``drain`` (the router's SIGTERM latch fired) and ``stop`` (the
+  listener closed after the verdict). The final per-host ledgers
+  land in the v6 SLO verdict's ``fleet`` block, which ``compare``
+  judges
 - ``rtrace``      — request-path lifecycle tracing (obs/rtrace.py),
   disambiguated by ``phase``: ``request`` (one SAMPLED request's full
   waterfall — seq, priority, tenant, total_ms, per-stage ms over the
@@ -215,6 +236,7 @@ KNOWN_KINDS = frozenset(
         "admission",
         "replica",
         "swap",
+        "fleet",
         "rtrace",
         "canary",
         "shadow",
@@ -390,7 +412,25 @@ def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     rtraces = [e for e in events if e.get("kind") == "rtrace"]
     canaries = [e for e in events if e.get("kind") == "canary"]
     shadows = [e for e in events if e.get("kind") == "shadow"]
+    fleets = [e for e in events if e.get("kind") == "fleet"]
     return {
+        "fleet_start": next(
+            (e for e in fleets if e.get("phase") == "start"), None
+        ),
+        "fleet_stats": next(
+            (
+                e for e in reversed(fleets)
+                if e.get("phase") == "stats"
+            ),
+            None,
+        ),
+        "fleet_probes": [
+            e for e in fleets if e.get("phase") == "probe"
+        ],
+        "fleet_drain": next(
+            (e for e in reversed(fleets) if e.get("phase") == "drain"),
+            None,
+        ),
         "canary_events": canaries,
         "canary_last": canaries[-1] if canaries else None,
         "canary_last_evaluate": next(

@@ -288,9 +288,10 @@ def _serving(events) -> Optional[Dict[str, Any]]:
     stats = digest["stats"]
     verdict = digest["verdict"]
     http_start = digest["http_start"]
+    fleet_start = digest["fleet_start"]
     if (
         not exports and start is None and not stats and verdict is None
-        and http_start is None
+        and http_start is None and fleet_start is None
     ):
         return None
     return {
@@ -344,9 +345,21 @@ def _serving(events) -> Optional[Dict[str, Any]]:
                           "wall_s", "scenario", "per_priority",
                           "per_tenant", "fairness_ratio", "slo",
                           "replicas", "scaling", "swap", "attribution",
-                          "canary")
+                          "canary", "fleet")
             }
             if verdict
+            else None
+        ),
+        "fleet": (
+            {
+                "hosts": fleet_start.get("hosts"),
+                "router": (
+                    f"{fleet_start.get('host')}:"
+                    f"{fleet_start.get('port')}"
+                ),
+                "probe_transitions": len(digest["fleet_probes"]),
+            }
+            if fleet_start
             else None
         ),
         "replica_restarts": len(digest["replica_restarts"]),
@@ -589,6 +602,14 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                     else ""
                 )
             )
+        fleet_info = serving.get("fleet")
+        if fleet_info:
+            lines.append(
+                f"serving: fleet router {fleet_info.get('router')} "
+                f"over {len(fleet_info.get('hosts') or [])} host(s) | "
+                f"{fleet_info.get('probe_transitions')} health "
+                "transition(s)"
+            )
         sv = serving.get("verdict")
         if sv:
             lines.append(
@@ -771,6 +792,54 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                         if (shadow.get('compared') or 0) > 0 else ""
                     )
                 )
+            # the v6 fleet block: per-host ledgers, the cross-host
+            # retry accounting, the per-host p99 spread and the
+            # summed-across-hosts drop count — the whole fleet episode
+            # reconstructable from the run dir alone
+            flt = sv.get("fleet")
+            if flt:
+                cons = flt.get("ledger_consistent")
+                lines.append(
+                    f"  fleet: {flt.get('n_hosts')} host(s) | "
+                    f"{flt.get('completed_total')} completed | "
+                    f"{flt.get('retries_total')} retries (rate "
+                    f"{flt.get('retry_rate')}) | p99 spread "
+                    f"{flt.get('host_p99_spread')} | dropped "
+                    f"{flt.get('dropped')} | ledger "
+                    + (
+                        "CONSISTENT" if cons
+                        else "TORN" if cons is False else "unchecked"
+                    )
+                )
+                for label in sorted(flt.get("hosts") or {}):
+                    h = (flt.get("hosts") or {})[label]
+                    retries = sum((h.get("retries") or {}).values())
+                    lines.append(
+                        f"    {label} [{h.get('state')}] "
+                        f"{h.get('host')}:{h.get('port')}: "
+                        f"{h.get('completed')} done / "
+                        f"{h.get('proxied')} proxied | p99 "
+                        f"{h.get('p99_ms')} ms | {retries} retry(s) | "
+                        f"{h.get('probe_transitions')} transition(s)"
+                    )
+                fswap = flt.get("swap")
+                if fswap:
+                    unshifted = fswap.get("hosts_unshifted") or []
+                    lines.append(
+                        f"    fleet swap: {fswap.get('state')} "
+                        f"({len(fswap.get('hosts_shifted') or [])}/"
+                        f"{fswap.get('hosts_total')} hosts shifted, "
+                        f"{fswap.get('seconds')}s)"
+                        + (
+                            f" — {fswap.get('error')}"
+                            if fswap.get("error") else ""
+                        )
+                        + (
+                            " | !! NOT shifted (still on the old "
+                            f"version if they rejoin): {unshifted}"
+                            if unshifted else ""
+                        )
+                    )
             # the v4 request-path attribution: per-priority p99
             # decomposed by lifecycle stage, the reconciliation
             # identity, and the slowest exemplars' waterfalls

@@ -73,6 +73,62 @@ def _serve_lines(events) -> List[str]:
                 else ""
             )
         )
+    fleet_start = digest["fleet_start"]
+    fleet_stats = digest["fleet_stats"]
+    if fleet_start:
+        lines.append(
+            f"fleet: router {fleet_start.get('host')}:"
+            f"{fleet_start.get('port')} over "
+            f"{len(fleet_start.get('hosts') or [])} host(s)"
+            + (
+                f" | scenario {fleet_start.get('scenario')}"
+                if fleet_start.get("scenario") else ""
+            )
+        )
+    if fleet_stats and verdict is None:
+        # the live per-host health/occupancy table: one row per host —
+        # state, in-flight, proxied/completed, retries burned — plus a
+        # loud banner for every host the prober has declared dead
+        age = time.time() - float(fleet_stats.get("t", time.time()))
+        lines.append(
+            f"hosts: {fleet_stats.get('hosts_ready')}/"
+            f"{fleet_stats.get('hosts_total')} ready | inflight "
+            f"{fleet_stats.get('inflight')} | unrouteable "
+            f"{fleet_stats.get('unrouteable')} | {age:.0f}s ago"
+        )
+        lines.append(
+            f"  {'id':<4} {'host':<18} {'state':<9} {'infl':>5} "
+            f"{'proxied':>8} {'done':>8} {'retries':>8}"
+        )
+        for label in sorted(fleet_stats.get("hosts") or {}):
+            h = (fleet_stats.get("hosts") or {})[label]
+            retries = sum((h.get("retries") or {}).values())
+            lines.append(
+                f"  {label:<4} "
+                f"{str(h.get('host')) + ':' + str(h.get('port')):<18} "
+                f"{str(h.get('state')):<9} {h.get('inflight'):>5} "
+                f"{h.get('proxied'):>8} {h.get('completed'):>8} "
+                f"{retries:>8}"
+            )
+            if h.get("state") == "dead":
+                lines.append(
+                    f"  !! host {label} DEAD — its traffic is being "
+                    "answered by peers (retry ledger above)"
+                )
+        fswap = fleet_stats.get("swap")
+        if fswap and fswap.get("state") in ("replicating", "shifting"):
+            lines.append(
+                f">> FLEET SWAP {fswap.get('state')}: "
+                f"{len(fswap.get('hosts_shifted') or [])}/"
+                f"{fswap.get('hosts_total')} hosts shifted "
+                "(one at a time — dispatch never loses two hosts)"
+            )
+    if digest["fleet_drain"] and verdict is None:
+        lines.append(
+            f"!! fleet draining (signal "
+            f"{digest['fleet_drain'].get('signum')}) — in-flight "
+            "proxies finishing, router readyz is 503"
+        )
     replica_stats = digest["replica_stats"]
     swap_last = digest["swap_last"]
     if replica_stats and verdict is None:
@@ -350,6 +406,31 @@ def _serve_lines(events) -> List[str]:
             if fired:
                 lines.append(
                     "    fired detectors: " + ", ".join(fired)
+                )
+        fleet = verdict.get("fleet")
+        if fleet:
+            lines.append(
+                f"  fleet: {fleet.get('n_hosts')} host(s) | "
+                f"{fleet.get('completed_total')} completed | "
+                f"{fleet.get('retries_total')} retries "
+                f"(rate {fleet.get('retry_rate')}) | p99 spread "
+                f"{fleet.get('host_p99_spread')} | dropped "
+                f"{fleet.get('dropped')} | ledger "
+                + (
+                    "CONSISTENT"
+                    if fleet.get("ledger_consistent")
+                    else "TORN" if fleet.get("ledger_consistent") is False
+                    else "unchecked"
+                )
+            )
+            for label in sorted(fleet.get("hosts") or {}):
+                h = (fleet.get("hosts") or {})[label]
+                lines.append(
+                    f"    {label} [{h.get('state')}]: "
+                    f"{h.get('completed')} done / "
+                    f"{h.get('proxied')} proxied | p99 "
+                    f"{h.get('p99_ms')} ms | retried away "
+                    f"{h.get('retried_away')}"
                 )
         att = verdict.get("attribution")
         if att:

@@ -36,6 +36,13 @@ Training's other half. Four modules, composing bottom-up:
   immutable published versions with a verified digest chain
   (index → artifact.json → weights.npz) + provenance, the store swap
   targets resolve from
+- :mod:`bdbnn_tpu.serve.fleet`    — the cross-host fleet router: N
+  serve-http hosts behind one health-routed listener (shared
+  warmup→debounce→hysteresis probe discipline, least-occupancy
+  dispatch), bounded retry-with-backoff host-failure tolerance
+  (relay-vs-retry preserving the shed taxonomy), digest-verified
+  registry replication and host-by-host fleet blue/green
+  (stdlib-only; the hosts own the engines)
 - :mod:`bdbnn_tpu.serve.canary`   — self-driving rollouts: the canary
   stage's live-verdict monitor (warmup→debounce→hysteresis detectors
   over per-cohort request windows, obs/health.py discipline) whose
@@ -44,9 +51,10 @@ Training's other half. Four modules, composing bottom-up:
   (stdlib-only)
 
 CLI surface: ``export`` / ``predict`` / ``serve-bench`` /
-``serve-http`` (``bdbnn_tpu.cli``). Import of this package root stays
-light — the modules lazy-import jax where they need it, so the
-batcher, admission, HTTP and verdict tooling all work backend-free.
+``serve-http`` / ``serve-fleet`` (``bdbnn_tpu.cli``). Import of this
+package root stays light — the modules lazy-import jax where they
+need it, so the batcher, admission, HTTP, fleet and verdict tooling
+all work backend-free.
 """
 
 from __future__ import annotations

@@ -131,6 +131,7 @@ class HttpFrontEnd:
         model_router: Optional[Callable[[str], str]] = None,
         tracer: Optional[Any] = None,
         canary: Optional[Any] = None,
+        server_id: Optional[str] = None,
     ):
         self.batcher = batcher
         self.admission = admission
@@ -174,6 +175,13 @@ class HttpFrontEnd:
         # configured for multi-model must not silently ignore a
         # routing request and answer from the wrong model.
         self.model_router = model_router
+        # fleet identity (serve/fleet.py): when a router fronts several
+        # hosts, each host advertises a stable id on /healthz//statsz
+        # and stamps its 200 responses with ``served_by``, so the
+        # router's host table and the client's answered-by accounting
+        # can be cross-checked against what the HOST says it is. None =
+        # single-host serving, responses unchanged.
+        self.server_id = server_id
         self._completed_by_model: Dict[str, int] = {}
         self._draining = threading.Event()
         # in-flight = /v1/predict handlers between request-parsed and
@@ -420,6 +428,7 @@ class HttpFrontEnd:
             self._respond(writer, 200, {
                 "status": "ok",
                 "ready": bool(self.ready_fn()) and not self.draining,
+                "server_id": self.server_id,
             })
         elif method == "GET" and path == "/readyz":
             if self.draining:
@@ -683,13 +692,19 @@ class HttpFrontEnd:
             self._completed_by_model[key] = (
                 self._completed_by_model.get(key, 0) + 1
             )
-        self._respond(writer, 200, {
+        payload_out = {
             "result": self.encode(result),
             "priority": priority,
             "tenant": tenant,
             "model": model_key,
             "latency_ms": round(lat_ms, 3),
-        })
+        }
+        if self.server_id is not None:
+            # fleet cross-check: WHO answered rides the response, so
+            # the router's per-host completed ledger can be audited
+            # against the hosts' own claims
+            payload_out["served_by"] = self.server_id
+        self._respond(writer, 200, payload_out)
         await writer.drain()
         if trace is not None:
             # respond span: future wakeup + encode + socket write; the
@@ -713,6 +728,7 @@ class HttpFrontEnd:
                 "draining" if self.draining
                 else "ready" if ready else "warming"
             ),
+            "server_id": self.server_id,
             "inflight": inflight,
             "requests_seen": self._requests_seen,
             "batcher": self.batcher.stats(),
@@ -1119,6 +1135,7 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
         model_router=model_router,
         tracer=tracer,
         canary=canary_monitor,
+        server_id=cfg.server_id or None,
     )
     host, port = front.start()
     events.emit(
@@ -1126,6 +1143,7 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
         phase="start",
         host=host,
         port=port,
+        server_id=cfg.server_id or None,
         artifact=os.path.abspath(artifact_dir),
         arch=engine.arch,
         buckets=list(engine.buckets),

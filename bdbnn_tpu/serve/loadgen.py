@@ -82,7 +82,17 @@ VERDICT_NAME = "verdict.json"
 # shadow-mirroring accounting with the max-abs logit drift, and the
 # promote wall seconds. Null when no canary stage ran, so v1-v4
 # consumers keep working unchanged.
-VERDICT_SCHEMA_VERSION = 5
+# v6: the ``fleet`` block (serve/fleet.py) — the cross-host router's
+# disposition: the per-host ledger table (proxied / completed /
+# relayed 429/503 / retries by cause / retried-away / probe
+# transitions), the fleet totals whose per-host sums must equal the
+# client observation (``ledger_consistent``), the zero-tolerance
+# ``dropped`` now summed across hosts, the retry rate and the
+# max/min per-host p99 spread — the sources of ``compare``'s
+# ``serve_fleet_dropped`` / ``serve_fleet_retry_rate`` /
+# ``serve_fleet_host_p99_spread`` gates. Null on single-host runs,
+# so v1-v5 consumers keep working unchanged.
+VERDICT_SCHEMA_VERSION = 6
 
 
 def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -428,8 +438,11 @@ def build_schedule(
     return out
 
 
-def _recv_response(rfile) -> Tuple[int, Dict[str, str], bytes]:
-    """Minimal HTTP/1.1 response parse off a socket makefile('rb')."""
+def recv_response(rfile) -> Tuple[int, Dict[str, str], bytes]:
+    """Minimal HTTP/1.1 response parse off a socket makefile('rb') —
+    shared by the socket load generator and the fleet router's proxy
+    client (serve/fleet.py), so both sides of the fleet speak exactly
+    the same wire dialect."""
     line = rfile.readline()
     if not line:
         raise ConnectionError("server closed the connection")
@@ -548,7 +561,7 @@ class HttpLoadGenerator:
             sock, rfile = conn
             try:
                 self._send(sock, i, arr)
-                status, headers, _body = _recv_response(rfile)
+                status, headers, _body = recv_response(rfile)
             except (OSError, ValueError, ConnectionError):
                 try:
                     sock.close()
@@ -691,6 +704,7 @@ def slo_verdict(
     packed: Optional[Dict[str, Any]] = None,
     attribution: Optional[Dict[str, Any]] = None,
     canary: Optional[Dict[str, Any]] = None,
+    fleet: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble the deterministic strict-JSON SLO verdict.
 
@@ -721,7 +735,13 @@ def slo_verdict(
     trigger, per-detector table, shadow-drift accounting — the source
     of ``compare``'s ``serve_canary_rollbacks`` /
     ``serve_shadow_logit_drift_max`` / ``serve_canary_promote_s``
-    gates. Null when no canary stage ran."""
+    gates. Null when no canary stage ran. The fleet router
+    (serve/fleet.py) adds the v6 ``fleet`` block: the per-host ledger
+    table, the cross-host retry/relay accounting, the summed-across-
+    hosts ``dropped`` and the per-host p99 spread — the source of
+    ``compare``'s ``serve_fleet_dropped`` / ``serve_fleet_retry_rate``
+    / ``serve_fleet_host_p99_spread`` gates. Null on single-host
+    runs."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
@@ -762,6 +782,7 @@ def slo_verdict(
         "packed": packed,
         "attribution": attribution,
         "canary": canary,
+        "fleet": fleet,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (
@@ -1629,6 +1650,7 @@ __all__ = [
     "fairness_ratio",
     "http_slo_verdict",
     "percentile",
+    "recv_response",
     "run_serve_bench",
     "slo_verdict",
     "write_verdict_files",

@@ -82,6 +82,16 @@ SWAP_CANARY = "canary"
 SWAP_ROLLING_BACK = "rolling_back"
 SWAP_ROLLED_BACK = "rolled_back"
 
+# the states in which a swap is no longer in flight — the fleet
+# router's host-by-host shift (serve/fleet.py) polls each host's
+# /admin/swap until its state lands here before touching the NEXT
+# host, so a rollout never takes two hosts out of dispatch at once.
+# One source of truth: a new in-flight state added above must be
+# deliberately excluded here or the fleet would shift early.
+SWAP_TERMINAL_STATES = frozenset({
+    SWAP_IDLE, SWAP_DONE, SWAP_FAILED, SWAP_ROLLED_BACK, "rejected",
+})
+
 
 class _Work:
     __slots__ = ("payloads", "future", "t_enqueue", "shadow")
@@ -2061,6 +2071,7 @@ __all__ = [
     "SWAP_ROLLED_BACK",
     "SWAP_ROLLING_BACK",
     "SWAP_SHIFTING",
+    "SWAP_TERMINAL_STATES",
     "SWAP_WARMING",
     "UNHEALTHY",
     "WARMING",

@@ -284,6 +284,114 @@ class ArtifactRegistry:
             if os.path.exists(staging):
                 shutil.rmtree(staging, ignore_errors=True)
 
+    def pull(
+        self,
+        remote_root: str,
+        version: Optional[int] = None,
+        lock_timeout_s: float = 30.0,
+    ) -> List[Dict[str, Any]]:
+        """Replicate versions from a REMOTE registry into this one —
+        the fleet's publish-time replication primitive (every serving
+        host pulls the version it is about to swap to, so a rollout
+        never trusts a path it did not verify). ``version`` limits the
+        pull to one version; None pulls every remote version absent
+        locally. Returns the list of local index entries written.
+
+        The digest chain is verified TWICE: the remote side resolves
+        through :meth:`resolve` (index -> artifact.json -> weights.npz
+        against the remote index), and the staged local copy is
+        re-hashed against the remote entry's recorded digests before
+        the rename — a copy torn mid-transfer (short read, full disk)
+        fails HERE and leaves the local registry untouched. Version
+        numbers and digests are preserved verbatim, so every host's
+        registry resolves version N to byte-identical artifacts."""
+        remote = ArtifactRegistry(remote_root)
+        if version is not None:
+            entry = remote.get(int(version))
+            if entry is None:
+                known = [e["version"] for e in remote.entries()]
+                raise KeyError(
+                    f"remote registry {remote_root!r} has no version "
+                    f"{version} (known: {known})"
+                )
+            wanted = [entry]
+        else:
+            # EVERY remote entry is considered: versions already local
+            # are digest-compared below (identical -> skipped, diverged
+            # -> a registry fork that must fail loudly)
+            wanted = list(remote.entries())
+        pulled: List[Dict[str, Any]] = []
+        for entry in sorted(wanted, key=lambda e: e["version"]):
+            v = int(entry["version"])
+            local = self.get(v)
+            if local is not None:
+                # idempotent re-pull of an identical version; a DIVERGED
+                # version number is a registry fork and must fail loudly
+                if (
+                    local.get("artifact_sha256")
+                    != entry.get("artifact_sha256")
+                    or local.get("weights_sha256")
+                    != entry.get("weights_sha256")
+                ):
+                    raise RuntimeError(
+                        f"pull: local version {v} exists with DIFFERENT "
+                        f"digests than {remote_root!r}'s — the registries "
+                        "have forked; refusing to overwrite"
+                    )
+                continue
+            src = remote.resolve(v)  # remote-side digest verification
+            os.makedirs(self.root, exist_ok=True)
+            staging = os.path.join(
+                self.root,
+                f".pull.tmp.{os.getpid()}.{threading.get_ident()}",
+            )
+            if os.path.exists(staging):
+                shutil.rmtree(staging)
+            try:
+                shutil.copytree(src, staging)
+                # verify the STAGED copy against the remote entry: a
+                # torn copy must never become a local "good" version
+                if (
+                    _file_sha256(os.path.join(staging, ARTIFACT_NAME))
+                    != entry["artifact_sha256"]
+                ):
+                    raise RuntimeError(
+                        f"pull: staged copy of version {v} does not "
+                        f"match {ARTIFACT_NAME}'s published digest — "
+                        "torn transfer; local registry untouched"
+                    )
+                if entry.get("weights_sha256") and (
+                    _file_sha256(os.path.join(staging, WEIGHTS_NAME))
+                    != entry["weights_sha256"]
+                ):
+                    raise RuntimeError(
+                        f"pull: staged copy of version {v} does not "
+                        "match the published weights digest — torn "
+                        "transfer; local registry untouched"
+                    )
+                with self._publish_lock(timeout_s=lock_timeout_s):
+                    index = self._read_index()
+                    if any(
+                        e["version"] == v for e in index["entries"]
+                    ):
+                        continue  # a concurrent puller won; theirs verified
+                    dest = os.path.join(self.root, _version_dirname(v))
+                    os.replace(staging, dest)
+                    new_entry = {
+                        **entry,
+                        "path": _version_dirname(v),
+                        "pulled_from": os.path.abspath(remote_root),
+                        "pulled_unix": round(time.time(), 3),
+                    }
+                    index["entries"].append(new_entry)
+                    index["entries"].sort(key=lambda e: e["version"])
+                    self._write_index(index)
+                    pulled.append(new_entry)
+            finally:
+                if os.path.exists(staging):
+                    shutil.rmtree(staging, ignore_errors=True)
+        return pulled
+
     def resolve(self, version: int) -> str:
         """Verified absolute path of a version's artifact dir: the index
         entry's recorded digests must match the bytes on disk (both the

@@ -230,26 +230,49 @@ def sim_device_subprocess():
 
 @pytest.fixture(scope="session")
 def port_allocator():
-    """Session-scoped free-port allocator: bind port 0, let the kernel
-    pick, remember the pick so no two callers in this session get the
-    same port (the kernel can re-issue a closed listener's port)."""
+    """Session-scoped free-port allocator, multi-process hardened:
+    **bind-and-hold handoff** instead of probe-then-release. ``alloc()``
+    binds port 0 and KEEPS the socket bound — while held, the kernel
+    cannot re-issue that port to any other port-0 bind on the box
+    (the race the old probe hit once fleet tests started handing ports
+    to host SUBPROCESSES whose bind happens seconds after the probe).
+    The holder is closed at handoff time: ``alloc(hold=True)`` returns
+    the port still held and the caller releases it with
+    ``alloc.release(port)`` immediately before binding; the default
+    ``hold=False`` releases on return (the in-process consumers bind
+    within microseconds). An explicit bind of a held port by an
+    unrelated process remains possible in the tiny release→bind
+    window — cluster-formation callers additionally wrap in
+    ``retry_once_flaky``."""
     import socket as _socket
 
     handed = set()
+    held = {}
 
-    def alloc() -> int:
+    def release(port: int) -> int:
+        s = held.pop(port, None)
+        if s is not None:
+            s.close()
+        return port
+
+    def alloc(hold: bool = False) -> int:
         while True:
             s = _socket.socket()
-            try:
-                s.bind(("127.0.0.1", 0))
-                port = s.getsockname()[1]
-            finally:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            if port in handed:
                 s.close()
-            if port not in handed:
-                handed.add(port)
-                return port
+                continue
+            handed.add(port)
+            held[port] = s
+            if not hold:
+                release(port)
+            return port
 
-    return alloc
+    alloc.release = release
+    yield alloc
+    for port in list(held):
+        release(port)
 
 
 @pytest.fixture
@@ -300,13 +323,17 @@ def http_frontend(port_allocator):
         if clock is not None:
             admission_kw["clock"] = clock
         admission = AdmissionController(**admission_kw)
+        # bind-and-hold handoff: the allocator keeps the port's socket
+        # bound until immediately before the front end binds it
+        port = port_allocator(hold=True)
         fe = HttpFrontEnd(
             batcher,
             admission,
             ready_fn=ready_fn or (lambda: True),
-            port=port_allocator(),
+            port=port,
             **front_kw,
         )
+        port_allocator.release(port)
         fe.start()
         started.append(fe)
         return fe

@@ -62,14 +62,15 @@ class TestEmitCallSites:
         against the AST walk silently matching nothing) — the training
         kinds, the four resilience kinds, the health-monitor kinds,
         the serving/front-end/replica-pool kinds, the request-tracing
-        and canary kinds, and the static analyzer's own ``analysis``
+        and canary kinds, the fleet router's ``fleet`` kind
+        (serve/fleet.py), and the static analyzer's own ``analysis``
         kind (the `check --events-into` emit in cli.py)."""
         _findings, found = scan_events(REPO, SCANNED)
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
                 "alert", "health", "export", "serve",
-                "http", "admission", "replica", "swap",
+                "http", "admission", "replica", "swap", "fleet",
                 "rtrace", "canary", "shadow", "analysis"} <= found
 
     def test_registry_matches_docs(self):
@@ -467,6 +468,96 @@ class TestStrictRfc8259:
         assert e["detectors"]["logit_drift"]["value"] is None
         assert d["evaluations"] == 11
         assert s["drift"] is None and s2["seq"] == 43
+
+    def test_fleet_kind_payloads_roundtrip(self, tmp_path):
+        """The fleet router's payload shapes (serve/fleet.py) with
+        adversarial values in the numeric slots: a NaN per-host p99 in
+        the stats table must land as null (never a bare token), numpy
+        counters must unwrap, and the nested per-host ledger /
+        retries-by-cause / swap structures must survive strict-RFC-8259
+        parsing."""
+        ev = EventWriter(str(tmp_path))
+        s = ev.emit(
+            "fleet",
+            phase="stats",
+            role="fleet-router",
+            draining=np.bool_(False),
+            hosts_total=np.int64(2),
+            hosts_ready=1,
+            inflight=np.int64(3),
+            unrouteable=0,
+            router_shed_draining=np.int64(0),
+            hosts={
+                "h0": {
+                    "host": "127.0.0.1", "port": np.int64(8100),
+                    "state": "dead", "server_id": "h0",
+                    "inflight": 0, "proxied": np.int64(420),
+                    "completed": 400,
+                    "relayed_429": np.int64(3), "relayed_503": 17,
+                    "relayed_other": 0,
+                    "retries": {"connect": np.int64(5),
+                                "timeout": 0, "reset": np.int64(2)},
+                    "retried_away": np.int64(7),
+                    "probes": 120, "probe_transitions": np.int64(2),
+                    "p99_ms": float("nan"),
+                },
+                "h1": {
+                    "host": "127.0.0.1", "port": 8101,
+                    "state": "ready", "server_id": "h1",
+                    "inflight": np.int64(3), "proxied": 600,
+                    "completed": np.int64(580),
+                    "relayed_429": 0, "relayed_503": np.int64(20),
+                    "relayed_other": 0,
+                    "retries": {"connect": 0, "timeout": 0,
+                                "reset": 0},
+                    "retried_away": 0,
+                    "probes": np.int64(120), "probe_transitions": 0,
+                    "p99_ms": np.float32(41.5),
+                },
+            },
+            swap=None,
+        )
+        p = ev.emit(
+            "fleet",
+            phase="probe",
+            host="h0",
+            state_from="ready",
+            state_to="dead",
+        )
+        x = ev.emit(
+            "fleet",
+            phase="proxy",
+            host="h0",
+            cause="reset",
+            attempt=np.int64(1),
+        )
+        w = ev.emit(
+            "fleet",
+            phase="swap",
+            state="done",
+            seconds=float("inf"),
+            hosts_shifted=np.int64(2),
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        assert lines[0]["kind"] == "fleet"
+        h0 = lines[0]["hosts"]["h0"]
+        assert h0["p99_ms"] is None  # NaN -> null, never a token
+        assert h0["retries"] == {"connect": 5, "timeout": 0,
+                                 "reset": 2}
+        assert isinstance(h0["proxied"], int)
+        assert lines[0]["hosts"]["h1"]["p99_ms"] == 41.5
+        assert lines[0]["draining"] is False
+        assert lines[1]["state_to"] == "dead"
+        assert lines[2]["cause"] == "reset"
+        assert isinstance(lines[2]["attempt"], int)
+        assert lines[3]["seconds"] is None  # Inf -> null
+        assert lines[3]["hosts_shifted"] == 2
+        # the emit() return values match what was written
+        assert s["hosts"]["h0"]["p99_ms"] is None
+        assert p["host"] == "h0" and x["attempt"] == 1
+        assert w["seconds"] is None
 
     def test_resilience_kind_payloads_roundtrip(self, tmp_path):
         """The extended pod-resilience payload shapes (train/loop.py):

@@ -1,0 +1,1151 @@
+"""Cross-host serving fleet tests (serve/fleet.py, registry.pull).
+
+Three tiers:
+
+1. **Router failure taxonomy in isolation** — scriptable stub backends
+   (raw threaded HTTP servers, no JAX) pin the per-host backoff
+   schedule, retry-never-duplicates (idempotent proxy accounting),
+   the relayed-vs-retried 429/503 split, the draining-host bleed, the
+   probe state machine (warmup→debounce→hysteresis via the shared
+   DetectorState), and the host-by-host fleet-swap serialization.
+2. **Registry replication** — digest-verified ``pull`` between two
+   on-disk registries, including the torn-remote case that must leave
+   the local registry untouched.
+3. **The fleet acceptance e2e** — 2 REAL serve-http host subprocesses
+   (the tests/pod_worker.py recipe: each pinned to its own simulated
+   device count, real sockets, the real CLI) behind the router,
+   flash-crowd load, SIGTERM one host mid-burst → zero client-visible
+   drops, the drained host's accepted requests answered by peers,
+   per-host ledgers summing to the client totals in the v6 ``fleet``
+   verdict block, and the episode consumed by watch/summarize/compare.
+   The SIGKILL variant is ``slow``-marked.
+
+Host ports in the e2e are kernel-assigned (``--port 0``) and
+discovered from each host's ``http`` start event — no cross-process
+port race at all; the conftest allocator's bind-and-hold handoff
+covers the ports tests DO pre-allocate in-process. Cluster formation
+is quarantined behind ``conftest.retry_once_flaky`` (tracking note in
+the fixture) for the documented subprocess bring-up transient.
+"""
+
+import glob
+import json
+import os
+import re
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from bdbnn_tpu.configs.config import ServeFleetConfig
+from bdbnn_tpu.obs.events import read_jsonl
+from bdbnn_tpu.serve.fleet import (
+    HOST_DEAD,
+    HOST_DRAINING,
+    HOST_READY,
+    FleetRouter,
+    backoff_s,
+    fleet_slo_verdict,
+    parse_hosts,
+    run_serve_fleet,
+)
+from bdbnn_tpu.serve.loadgen import recv_response
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+
+# ---------------------------------------------------------------------------
+# helpers: a scriptable stub backend + a raw one-shot HTTP client
+# ---------------------------------------------------------------------------
+
+
+class StubBackend:
+    """A minimal threaded HTTP backend whose behavior per route is
+    scripted by the test: the router sees a real socket peer without
+    any JAX/engine machinery. ``predict`` returns ``(status, obj)`` or
+    the string ``"die"`` to tear the connection without a response
+    (the SIGKILL-shaped transport failure)."""
+
+    def __init__(self, server_id, predict=None, admin=None):
+        self.server_id = server_id
+        self.predict = predict or (
+            lambda headers, body: (200, {"result": 1})
+        )
+        self.admin = admin
+        self.ready_state = "ready"
+        self.predict_seen = 0
+        self._lock = threading.Lock()
+        backend = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            timeout = 10.0
+
+            def handle(self):
+                from bdbnn_tpu.serve.fleet import _read_request
+
+                while True:
+                    try:
+                        req = _read_request(self.rfile, 2**20)
+                    except (ValueError, OSError):
+                        return
+                    if req is None:
+                        return
+                    method, path, headers, body = req
+                    out = backend._route(method, path, headers, body)
+                    if out == "die":
+                        return  # close without a response
+                    status, obj = out
+                    payload = json.dumps(obj).encode()
+                    head = (
+                        f"HTTP/1.1 {status} X\r\n"
+                        "content-type: application/json\r\n"
+                        f"content-length: {len(payload)}\r\n"
+                    )
+                    if status in (429, 503):
+                        head += "retry-after: 1\r\n"
+                    try:
+                        self.wfile.write(
+                            head.encode() + b"\r\n" + payload
+                        )
+                        self.wfile.flush()
+                    except OSError:
+                        return
+                    if headers.get("connection", "") == "close":
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._srv = Server(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            kwargs={"poll_interval": 0.05},
+        )
+        self._thread.start()
+
+    def _route(self, method, path, headers, body):
+        if path == "/readyz":
+            state = self.ready_state
+            return (
+                (200, {"state": state})
+                if state == "ready"
+                else (503, {"state": state})
+            )
+        if path == "/statsz":
+            return 200, {
+                "state": self.ready_state,
+                "inflight": 0,
+                "server_id": self.server_id,
+            }
+        if path.startswith("/admin/swap") and self.admin is not None:
+            return self.admin(method, body)
+        if path == "/v1/predict":
+            with self._lock:
+                self.predict_seen += 1
+            return self.predict(headers, body)
+        return 404, {"error": "no route"}
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def _predict(host, port, body=b"[1]", priority=0, timeout=10.0):
+    """One raw predict against a router — (status, headers, obj)."""
+    s = socket.create_connection((host, port), timeout=timeout)
+    try:
+        s.sendall(
+            (
+                f"POST /v1/predict HTTP/1.1\r\nhost: x\r\n"
+                f"x-priority: {priority}\r\n"
+                "content-type: application/octet-stream\r\n"
+                f"content-length: {len(body)}\r\n"
+                "connection: close\r\n\r\n"
+            ).encode()
+            + body
+        )
+        status, headers, raw = recv_response(s.makefile("rb"))
+        return status, headers, json.loads(raw) if raw else None
+    finally:
+        s.close()
+
+
+def _router_over(backends, **kw):
+    kw.setdefault("probe_interval_s", 0.05)
+    kw.setdefault("probe_timeout_s", 1.0)
+    kw.setdefault("health_debounce", 2)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("backoff_cap_s", 0.01)
+    router = FleetRouter(
+        [("127.0.0.1", b.port) for b in backends], **kw
+    )
+    router.start()
+    assert router.wait_ready(10.0), "no backend probed ready"
+    return router
+
+
+# ---------------------------------------------------------------------------
+# 1. router failure taxonomy in isolation
+# ---------------------------------------------------------------------------
+
+
+class TestBackoffSchedule:
+    def test_schedule_pins(self):
+        """The per-host retry backoff schedule, pinned value by value:
+        base*2^attempt, hard-capped — a refactor cannot silently turn
+        bounded backoff into a hot retry loop or an unbounded sleep."""
+        assert backoff_s(0, 0.025, 0.25) == pytest.approx(0.025)
+        assert backoff_s(1, 0.025, 0.25) == pytest.approx(0.05)
+        assert backoff_s(2, 0.025, 0.25) == pytest.approx(0.1)
+        assert backoff_s(3, 0.025, 0.25) == pytest.approx(0.2)
+        assert backoff_s(4, 0.025, 0.25) == pytest.approx(0.25)  # cap
+        assert backoff_s(50, 0.025, 0.25) == pytest.approx(0.25)
+        assert backoff_s(-1, 0.025, 0.25) == pytest.approx(0.025)
+
+    def test_parse_hosts(self):
+        assert parse_hosts(("127.0.0.1:81", "h:9")) == [
+            ("127.0.0.1", 81), ("h", 9),
+        ]
+
+
+class TestFleetConfigValidation:
+    def test_needs_hosts(self):
+        with pytest.raises(ValueError, match="at least one backend"):
+            ServeFleetConfig(hosts=()).validate()
+
+    def test_bad_host_spec(self):
+        with pytest.raises(ValueError, match="HOST:PORT"):
+            ServeFleetConfig(hosts=("nope",)).validate()
+        with pytest.raises(ValueError, match="duplicate"):
+            ServeFleetConfig(
+                hosts=("a:1", "a:1")
+            ).validate()
+
+    def test_scenario_needs_artifact(self):
+        with pytest.raises(ValueError, match="ARTIFACT"):
+            ServeFleetConfig(
+                hosts=("a:1",), scenario="poisson"
+            ).validate()
+
+    def test_swap_version_needs_registry(self):
+        with pytest.raises(ValueError, match="registry"):
+            ServeFleetConfig(
+                hosts=("a:1",), swap_to="v0002"
+            ).validate()
+
+    def test_host_registries_arity(self):
+        with pytest.raises(ValueError, match="one registry root per"):
+            ServeFleetConfig(
+                hosts=("a:1", "b:2"), host_registries=("r1",)
+            ).validate()
+
+    def test_swap_at_needs_scenario_and_target(self):
+        with pytest.raises(ValueError, match="swap-to"):
+            ServeFleetConfig(hosts=("a:1",), swap_at=0.5).validate()
+
+
+class TestRouterTaxonomy:
+    def test_spreads_by_occupancy_and_health(self):
+        a, b = StubBackend("a"), StubBackend("b")
+        router = _router_over([a, b])
+        try:
+            for _ in range(12):
+                status, headers, obj = _predict(
+                    "127.0.0.1", router.port
+                )
+                assert status == 200
+                assert headers.get("x-served-by") in ("h0", "h1")
+            stats = router.stats()
+            # both hosts took load; identity advertised via /statsz
+            assert stats["hosts"]["h0"]["completed"] > 0
+            assert stats["hosts"]["h1"]["completed"] > 0
+            assert stats["hosts"]["h0"]["server_id"] == "a"
+            assert stats["hosts"]["h1"]["server_id"] == "b"
+            assert (
+                stats["hosts"]["h0"]["completed"]
+                + stats["hosts"]["h1"]["completed"]
+                == 12
+            )
+        finally:
+            router.drain(5.0)
+            a.stop()
+            b.stop()
+
+    def test_retry_never_duplicates(self):
+        """A host tearing every predict connection (reset, no
+        response) burns retries — ledgered per host and per cause —
+        while the peer answers each request EXACTLY once: idempotent
+        proxy accounting, client sees only 200s."""
+        a = StubBackend("a", predict=lambda h, b: "die")
+        b = StubBackend("b")
+        router = _router_over([a, b], max_attempts=3)
+        try:
+            n = 10
+            for _ in range(n):
+                status, _h, _o = _predict("127.0.0.1", router.port)
+                assert status == 200
+            stats = router.stats()
+            h0, h1 = stats["hosts"]["h0"], stats["hosts"]["h1"]
+            # the peer answered every request once — never a duplicate
+            # completion anywhere in the ledger
+            assert h1["completed"] == b.predict_seen
+            assert h0["completed"] == 0
+            assert h1["completed"] + h0["completed"] == n
+            # every torn attempt ledgered on the torn host, by cause
+            assert h0["retried_away"] == h0["retries"]["reset"]
+            assert h0["retried_away"] > 0
+            assert h0["retried_away"] == a.predict_seen
+            assert sum(h1["retries"].values()) == 0
+        finally:
+            router.drain(5.0)
+            a.stop()
+            b.stop()
+
+    def test_connect_refused_retries_on_peer(self):
+        """A host that dies between probe-ready and dispatch (the
+        SIGKILL window): connect refused -> retried on the peer, cause
+        'connect' ledgered, zero client-visible failures."""
+        a, b = StubBackend("a"), StubBackend("b")
+        router = _router_over([a, b], probe_interval_s=5.0)
+        try:
+            # probes have seen both hosts ready; now kill a's listener
+            # — the prober (5s interval) cannot save the router, only
+            # the per-request retry can
+            a.stop()
+            completed = 0
+            for _ in range(8):
+                status, _h, _o = _predict("127.0.0.1", router.port)
+                assert status == 200
+                completed += 1
+            stats = router.stats()
+            assert stats["hosts"]["h1"]["completed"] == completed
+            h0 = stats["hosts"]["h0"]
+            assert h0["retries"]["connect"] + h0["retries"]["reset"] > 0
+            assert h0["completed"] == 0
+        finally:
+            router.drain(5.0)
+            b.stop()
+
+    def test_relayed_429_503_not_retried(self):
+        """A well-formed backend shed is RELAYED with its taxonomy
+        (and retry-after) intact — never retried into a duplicate on
+        the healthy peer."""
+        a = StubBackend(
+            "a", predict=lambda h, b: (503, {"error": "queue full"})
+        )
+        router = _router_over([a])
+        try:
+            status, headers, obj = _predict("127.0.0.1", router.port)
+            assert status == 503
+            assert obj["error"] == "queue full"
+            assert headers.get("retry-after") == "1"
+            status, _h, obj = _predict(
+                "127.0.0.1", router.port, priority=1
+            )
+            assert status == 503
+            a.predict = lambda h, b: (429, {"error": "over_quota"})
+            status, headers, obj = _predict("127.0.0.1", router.port)
+            assert status == 429 and obj["error"] == "over_quota"
+            stats = router.stats()
+            h0 = stats["hosts"]["h0"]
+            assert h0["relayed_503"] == 2
+            assert h0["relayed_429"] == 1
+            assert sum(h0["retries"].values()) == 0
+            # the per-priority ledger files each relay under the
+            # backend's own reason
+            acct = router.accounting()
+            assert acct["counts_by_priority"][0][
+                "shed_queue_full"] == 1
+            assert acct["counts_by_priority"][1][
+                "shed_queue_full"] == 1
+            assert acct["counts_by_priority"][0][
+                "shed_over_quota"] == 1
+        finally:
+            router.drain(5.0)
+            a.stop()
+
+    def test_draining_host_bleeds_and_leaves_dispatch(self):
+        """A host flipping /readyz to draining leaves the dispatch set
+        on the next probe WITHOUT burning the failure detector; its
+        in-flight work completes (the bleed); with no host left the
+        router's own shed is explicit — never a dropped connection."""
+        gate = threading.Event()
+
+        def slow_predict(headers, body):
+            gate.wait(5.0)
+            return 200, {"result": "slow"}
+
+        a = StubBackend("a", predict=slow_predict)
+        router = _router_over([a])
+        try:
+            results = []
+            t = threading.Thread(
+                target=lambda: results.append(
+                    _predict("127.0.0.1", router.port)
+                )
+            )
+            t.start()
+            time.sleep(0.2)  # request is in flight on a
+            a.ready_state = "draining"
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with router._lock:
+                    if router.hosts[0].state == HOST_DRAINING:
+                        break
+                time.sleep(0.02)
+            with router._lock:
+                assert router.hosts[0].state == HOST_DRAINING
+                assert router.hosts[0].detector.fired == 0
+            gate.set()  # the bleed: the accepted request completes
+            t.join(5.0)
+            assert results and results[0][0] == 200
+            # new traffic: no dispatchable host -> explicit 503
+            status, headers, obj = _predict("127.0.0.1", router.port)
+            assert status == 503
+            assert obj["error"] == "no host available"
+            assert headers.get("retry-after")
+            acct = router.accounting()
+            assert acct["counts_by_priority"][0][
+                "shed_unavailable"] == 1
+        finally:
+            gate.set()
+            router.drain(5.0)
+            a.stop()
+
+    def test_probe_state_machine_debounce_and_recovery(self):
+        """warmup→debounce→hysteresis, probed DETERMINISTICALLY (the
+        probe loop parked on a long interval; the test drives
+        _probe_host by hand): two failed probes are not death under
+        debounce 3; the third fires exactly once; a dead host re-arms
+        on the first successful probe."""
+        a = StubBackend("a")
+        port = a.port
+        router = FleetRouter(
+            [("127.0.0.1", port)],
+            probe_interval_s=60.0,  # park the loop: manual probes only
+            probe_timeout_s=0.5,
+            health_debounce=3,
+        )
+        router.start()
+        h = router.hosts[0]
+        try:
+            router._probe_host(h)
+            with router._lock:
+                assert h.state == HOST_READY
+            a.stop()  # connect refused from here on
+            router._probe_host(h)
+            router._probe_host(h)
+            with router._lock:
+                # two consecutive breaches: below debounce, the last
+                # known state holds — one blip is not an eviction
+                assert h.state == HOST_READY
+                assert h.detector.fired == 0
+            router._probe_host(h)
+            with router._lock:
+                assert h.state == HOST_DEAD
+                assert h.detector.fired == 1
+            router._probe_host(h)  # still dead, no double-fire
+            with router._lock:
+                assert h.state == HOST_DEAD
+                assert h.detector.fired == 1
+            # resurrection on the SAME port: hysteresis re-arms on the
+            # first good probe and the host returns to dispatch
+            b = StubBackend("a2")
+            b._srv.server_close()
+            srv = type(b._srv)(
+                ("127.0.0.1", port), b._srv.RequestHandlerClass
+            )
+            b._srv = srv
+            threading.Thread(
+                target=srv.serve_forever, daemon=True,
+                kwargs={"poll_interval": 0.05},
+            ).start()
+            router._probe_host(h)
+            with router._lock:
+                assert h.state == HOST_READY
+                assert h.transitions >= 2
+            srv.shutdown()
+            srv.server_close()
+        finally:
+            router.drain(5.0)
+
+    def test_statsz_failure_never_feeds_the_detector(self):
+        """/statsz is enrichment only: a host that ANSWERS /readyz is
+        alive even when its stats route tears every connection — the
+        failure detector must never fire off the enrichment fetch
+        (review-hardening pin)."""
+        a = StubBackend("a")
+        orig_route = a._route
+
+        def route(method, path, headers, body):
+            if path == "/statsz":
+                return "die"  # torn connection on the stats fetch
+            return orig_route(method, path, headers, body)
+
+        a._route = route
+        router = FleetRouter(
+            [("127.0.0.1", a.port)],
+            probe_interval_s=60.0,
+            probe_timeout_s=0.5,
+            health_debounce=2,
+        )
+        router.start()
+        h = router.hosts[0]
+        try:
+            for _ in range(5):  # well past debounce
+                router._probe_host(h)
+            with router._lock:
+                assert h.state == HOST_READY
+                assert h.detector.fired == 0
+                assert h.last_statsz is None  # stale, not fatal
+            status, _h, _o = _predict("127.0.0.1", router.port)
+            assert status == 200
+        finally:
+            router.drain(5.0)
+            a.stop()
+
+    def test_fleet_swap_host_by_host(self):
+        """The fleet rollout shifts hosts SERIALLY: at no instant are
+        two hosts' swap machines active, and the router polls each to
+        a terminal state before touching the next."""
+        active = []
+        max_active = [0]
+        lock = threading.Lock()
+
+        def make_admin(label):
+            state = {"state": "idle"}
+
+            def admin(method, body):
+                if method == "POST":
+                    with lock:
+                        active.append(label)
+                        max_active[0] = max(
+                            max_active[0], len(active)
+                        )
+                    state["state"] = "shifting"
+
+                    def finish():
+                        time.sleep(0.15)
+                        state["state"] = "done"
+                        with lock:
+                            active.remove(label)
+
+                    threading.Thread(
+                        target=finish, daemon=True
+                    ).start()
+                    return 202, {"accepted": label}
+                return 200, {"current": dict(state), "last": None}
+
+            return admin
+
+        a = StubBackend("a", admin=make_admin("a"))
+        b = StubBackend("b", admin=make_admin("b"))
+        router = _router_over([a, b], swap_host_timeout_s=10.0)
+        try:
+            status, payload = router.start_fleet_swap(
+                {"artifact": "/tmp/whatever"}
+            )
+            assert status == 202
+            # a second trigger while rolling is refused
+            status2, _p = router.start_fleet_swap(
+                {"artifact": "/tmp/other"}
+            )
+            assert status2 == 409
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                with router._lock:
+                    swap = dict(router._swap)
+                if swap["state"] in ("done", "failed"):
+                    break
+                time.sleep(0.05)
+            assert swap["state"] == "done", swap
+            assert swap["hosts_shifted"] == ["h0", "h1"]
+            assert swap["hosts_unshifted"] == []
+            assert max_active[0] == 1, (
+                "two hosts were mid-shift at once"
+            )
+        finally:
+            router.drain(10.0)
+            a.stop()
+            b.stop()
+
+    def test_router_endpoints(self):
+        a = StubBackend("a")
+        router = _router_over([a])
+        try:
+            for path, want in (
+                ("/healthz", 200), ("/readyz", 200),
+                ("/statsz", 200), ("/fleet/hosts", 200),
+                ("/fleet/swap", 200), ("/nope", 404),
+            ):
+                s = socket.create_connection(
+                    ("127.0.0.1", router.port), timeout=5
+                )
+                s.sendall(
+                    f"GET {path} HTTP/1.1\r\nhost: x\r\n"
+                    "connection: close\r\n\r\n".encode()
+                )
+                status, _h, body = recv_response(s.makefile("rb"))
+                s.close()
+                assert status == want, path
+            # bad x-priority -> 400, never proxied
+            status, _h, obj = _predict(
+                "127.0.0.1", router.port, priority=9
+            )
+            assert status == 400 and "x-priority" in obj["error"]
+        finally:
+            router.drain(5.0)
+            a.stop()
+
+
+class TestFleetVerdict:
+    def test_v6_fleet_block_and_compare_gates(self, tmp_path):
+        """The verdict pipeline end to end over stub hosts: v6 schema,
+        ledger consistency computed against the client observation,
+        the compare flattener's fleet keys pinned BOTH directions
+        (v5-shaped verdicts skip; fleet verdicts judge), and a
+        doctored fleet-dropped regression exiting 3 through the real
+        compare CLI."""
+        from bdbnn_tpu.obs.compare import _serve_metrics
+        from bdbnn_tpu.serve.loadgen import (
+            HttpLoadGenerator,
+            build_schedule,
+        )
+
+        a, b = StubBackend("a"), StubBackend("b")
+        router = _router_over([a, b])
+        try:
+            schedule = build_schedule(
+                "poisson", requests=40, rate=400.0, seed=0
+            )
+            gen = HttpLoadGenerator(
+                "127.0.0.1", router.port, schedule,
+                body_fn=lambda i: b"[1]", concurrency=4,
+            )
+            client = gen.run()
+            assert client["dropped"] == 0
+            router.drain(5.0)
+            fleet = router.fleet_block(client=client)
+            verdict = fleet_slo_verdict(
+                router.accounting(), fleet,
+                scenario="poisson", rate=400.0, seed=0,
+                client=client,
+            )
+        finally:
+            a.stop()
+            b.stop()
+        assert verdict["serve_verdict"] == 6
+        assert verdict["mode"] == "fleet"
+        flt = verdict["fleet"]
+        assert flt["dropped"] == 0
+        assert flt["ledger_consistent"] is True
+        assert flt["completed_total"] == verdict["requests_completed"]
+        assert flt["completed_total"] == client["by_status"]["200"]
+        assert flt["retry_rate"] == 0.0
+        assert flt["host_p99_spread"] is not None  # both hosts served
+        # per-priority skeleton matches the http verdict's shape
+        assert set(verdict["per_priority"]) <= {"0", "1", "2"}
+
+        # the flattener, pinned both directions
+        m = _serve_metrics(verdict)
+        assert m["serve_fleet_dropped"] == 0
+        assert m["serve_fleet_retry_rate"] == 0.0
+        assert m["serve_fleet_host_p99_spread"] == flt[
+            "host_p99_spread"
+        ]
+        old = _serve_metrics({"p99_ms": 1.0})  # v1-v5: no fleet block
+        assert old["serve_fleet_dropped"] is None
+        assert old["serve_fleet_retry_rate"] is None
+        assert old["serve_fleet_host_p99_spread"] is None
+
+        # compare exits 3 on a doctored fleet-dropped regression
+        from bdbnn_tpu.cli import main as cli_main
+
+        base = tmp_path / "verdict.json"
+        base.write_text(json.dumps(verdict))
+        doctored = dict(verdict)
+        doctored["fleet"] = {**flt, "dropped": 3}
+        cand = tmp_path / "doctored.json"
+        cand.write_text(json.dumps(doctored))
+        assert cli_main(
+            ["compare", str(base), str(base), "--json"]
+        ) == 0
+        assert cli_main(
+            ["compare", str(base), str(cand), "--json"]
+        ) == 3
+
+
+# ---------------------------------------------------------------------------
+# 2. registry replication: digest-verified pull
+# ---------------------------------------------------------------------------
+
+
+def _fake_artifact(d, payload=b"fake-weights-bytes"):
+    """A minimal on-disk export artifact (manifest + weights blob with
+    a true digest chain) — the registry hashes bytes, it never loads
+    weights, so no numpy/JAX is needed."""
+    from bdbnn_tpu.serve.export import WEIGHTS_NAME, _file_sha256
+
+    os.makedirs(d, exist_ok=True)
+    wpath = os.path.join(d, WEIGHTS_NAME)
+    with open(wpath, "wb") as f:
+        f.write(payload)
+    manifest = {
+        "arch": "resnet8_tiny",
+        "dataset": "cifar10",
+        "image_size": 32,
+        "num_classes": 10,
+        "weights_sha256": _file_sha256(wpath),
+        "provenance": {"config_hash": "cafe", "recipe": {}},
+        "eval": {"checkpoint_acc1": 50.0},
+    }
+    with open(os.path.join(d, "artifact.json"), "w") as f:
+        json.dump(manifest, f)
+    return d
+
+
+class TestRegistryPull:
+    def test_pull_replicates_with_verified_digests(self, tmp_path):
+        from bdbnn_tpu.serve.registry import ArtifactRegistry
+
+        art = _fake_artifact(str(tmp_path / "art"))
+        primary = ArtifactRegistry(str(tmp_path / "primary"))
+        e1 = primary.publish(art)
+        local = ArtifactRegistry(str(tmp_path / "hostA"))
+        pulled = local.pull(primary.root)
+        assert [p["version"] for p in pulled] == [e1["version"]]
+        # same version number, same digests, provenance preserved,
+        # pull lineage recorded
+        got = local.get(e1["version"])
+        assert got["weights_sha256"] == e1["weights_sha256"]
+        assert got["artifact_sha256"] == e1["artifact_sha256"]
+        assert got["pulled_from"] == os.path.abspath(primary.root)
+        # the local resolve chain verifies end to end
+        assert os.path.isdir(local.resolve(e1["version"]))
+        # idempotent re-pull: nothing new
+        assert local.pull(primary.root) == []
+
+    def test_pull_single_version_and_unknown(self, tmp_path):
+        from bdbnn_tpu.serve.registry import ArtifactRegistry
+
+        art1 = _fake_artifact(str(tmp_path / "a1"), b"one")
+        art2 = _fake_artifact(str(tmp_path / "a2"), b"two")
+        primary = ArtifactRegistry(str(tmp_path / "primary"))
+        primary.publish(art1)
+        e2 = primary.publish(art2)
+        local = ArtifactRegistry(str(tmp_path / "host"))
+        pulled = local.pull(primary.root, version=e2["version"])
+        assert [p["version"] for p in pulled] == [e2["version"]]
+        assert local.get(1) is None  # only the asked-for version
+        with pytest.raises(KeyError, match="no version 99"):
+            local.pull(primary.root, version=99)
+
+    def test_torn_remote_pull_fails_verified_registry_untouched(
+        self, tmp_path
+    ):
+        """The acceptance case: a remote version torn AFTER publish
+        (bytes no longer match the published digests) must fail the
+        pull loudly and leave the LOCAL registry with no entry and no
+        version dir — a torn replica can never become servable."""
+        from bdbnn_tpu.serve.registry import (
+            REGISTRY_NAME,
+            ArtifactRegistry,
+        )
+
+        art = _fake_artifact(str(tmp_path / "art"))
+        primary = ArtifactRegistry(str(tmp_path / "primary"))
+        e1 = primary.publish(art)
+        # tear the remote replica: truncate the published weights
+        with open(
+            os.path.join(
+                primary.root, e1["path"], "weights.npz"
+            ),
+            "wb",
+        ) as f:
+            f.write(b"torn")
+        local_root = str(tmp_path / "host")
+        local = ArtifactRegistry(local_root)
+        with pytest.raises(RuntimeError, match="digest|match"):
+            local.pull(primary.root)
+        # untouched: no index, no version dirs, no staging debris
+        assert not os.path.exists(
+            os.path.join(local_root, REGISTRY_NAME)
+        )
+        leftovers = (
+            os.listdir(local_root)
+            if os.path.isdir(local_root) else []
+        )
+        assert [n for n in leftovers if not n.startswith(".")] == []
+        assert local.entries() == []
+
+    def test_forked_registries_refuse(self, tmp_path):
+        from bdbnn_tpu.serve.registry import ArtifactRegistry
+
+        a1 = _fake_artifact(str(tmp_path / "a1"), b"one")
+        a2 = _fake_artifact(str(tmp_path / "a2"), b"two")
+        primary = ArtifactRegistry(str(tmp_path / "primary"))
+        primary.publish(a1)
+        local = ArtifactRegistry(str(tmp_path / "host"))
+        local.publish(a2)  # local v0001 differs from remote v0001
+        with pytest.raises(RuntimeError, match="forked"):
+            local.pull(primary.root)
+
+
+# ---------------------------------------------------------------------------
+# 3. the fleet acceptance e2e: real serve-http subprocesses
+# ---------------------------------------------------------------------------
+
+
+def _host_env(devices=2):
+    """The tests/pod_worker.py env recipe: a fresh process pinned to
+    its own simulated device count."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={devices}"
+    ).strip()
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    return env
+
+
+def _spawn_host(art_dir, root, server_id):
+    """One fleet host: the REAL serve-http CLI in serve mode (no
+    scenario — it answers until SIGTERM), port 0 (kernel-assigned,
+    discovered from the http start event: no cross-process port race
+    at all)."""
+    argv = [
+        sys.executable, "-m", "bdbnn_tpu.cli", "serve-http", art_dir,
+        "--log-path", str(root),
+        "--port", "0",
+        "--buckets", "1", "8",
+        "--queue-depth", "8",
+        "--max-delay-ms", "2",
+        "--default-quota", "100000:100000",
+        "--server-id", server_id,
+        "--rtrace-sample-every", "64",
+    ]
+    return subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=_host_env(devices=2),
+        cwd=REPO_ROOT,
+    )
+
+
+def _host_events(root):
+    hits = glob.glob(
+        os.path.join(str(root), "**", "events.jsonl"), recursive=True
+    )
+    events = []
+    for h in sorted(hits):
+        events += read_jsonl(h)
+    return events
+
+
+def _wait_host_ready(root, proc, timeout=240.0):
+    """Poll the host's run dir until its http start AND ready events
+    land; returns the bound port. Raises AssertionError (the
+    retry-once boundary) if the host died or timed out instead."""
+    deadline = time.time() + timeout
+    port = None
+    while time.time() < deadline:
+        events = _host_events(root)
+        for e in events:
+            if e.get("kind") == "http" and e.get("phase") == "start":
+                port = e.get("port")
+        if port is not None and any(
+            e.get("kind") == "http" and e.get("phase") == "ready"
+            for e in events
+        ):
+            return port
+        if proc.poll() is not None:
+            out, err = proc.communicate(timeout=10)
+            raise AssertionError(
+                f"fleet host died during bring-up rc={proc.returncode}"
+                f"\nstdout:{out[-1200:]}\nstderr:{err[-2500:]}"
+            )
+        time.sleep(0.2)
+    raise AssertionError("fleet host never reached http ready")
+
+
+def _reap_hosts(procs, timeout=60):
+    outs = []
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs.append((p.returncode, out, err))
+    return outs
+
+
+def _form_fleet(art_dir, roots):
+    """Bring up one host subprocess per root; AssertionError when the
+    cluster never forms (the retry_once_flaky boundary)."""
+    procs = []
+    try:
+        for i, root in enumerate(roots):
+            procs.append(_spawn_host(art_dir, root, f"h{i}"))
+        ports = [
+            _wait_host_ready(root, proc)
+            for root, proc in zip(roots, procs)
+        ]
+    except BaseException:
+        _reap_hosts(procs, timeout=10)
+        raise
+    return procs, ports
+
+
+class TestFleetEndToEnd:
+    """THE fleet acceptance: 2 real serve-http hosts (2 simulated
+    devices each) over real sockets, flash-crowd load through the
+    router, SIGTERM one host mid-burst."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self, exported_artifact, tmp_path_factory):
+        """Cluster formation quarantined behind
+        conftest.retry_once_flaky (the ONE bounded retry-once policy)
+        for the documented transient: a serve-http subprocess dying or
+        timing out during jax-import/AOT bring-up on a contended box
+        (the pod_worker GRPC precedent, PR 7/8/9 notes). Every
+        post-formation contract is asserted by the tests and fails
+        deterministically."""
+        from conftest import retry_once_flaky
+
+        art_dir, _ = exported_artifact
+
+        def attempt(i):
+            tag = "fleet" if i == 0 else "fleet_retry"
+            roots = [
+                tmp_path_factory.mktemp(f"{tag}_h{j}")
+                for j in range(2)
+            ]
+            procs, ports = _form_fleet(art_dir, roots)
+            return {
+                "art": art_dir,
+                "procs": procs,
+                "ports": ports,
+                "roots": roots,
+            }
+
+        fleet = retry_once_flaky(
+            attempt,
+            note=(
+                "fleet host cluster attempt 1 never formed "
+                "(serve-http subprocess bring-up transient on "
+                "contended boxes — jax import + AOT warmup racing "
+                "the formation timeout; pod_worker precedent)"
+            ),
+        )
+        yield fleet
+        _reap_hosts(fleet["procs"], timeout=30)
+
+    def test_sigterm_one_host_mid_flash_crowd(self, fleet, tmp_path):
+        """SIGTERM host 0 inside the flash-crowd burst: the fleet
+        keeps serving, the dead host's accepted requests are answered
+        (zero client drops), per-host ledgers sum to the client
+        totals in the v6 fleet block, and the episode is consumable
+        by watch, summarize and compare."""
+        from bdbnn_tpu.obs.events import read_events
+        from bdbnn_tpu.obs.summarize import summarize_run
+        from bdbnn_tpu.obs.watch import render_status
+
+        cfg = ServeFleetConfig(
+            hosts=tuple(
+                f"127.0.0.1:{p}" for p in fleet["ports"]
+            ),
+            artifact=fleet["art"],
+            log_path=str(tmp_path / "fleet_run"),
+            scenario="flash_crowd",
+            rate=120.0,
+            requests=700,
+            concurrency=12,
+            flash_factor=8.0,
+            seed=0,
+            probe_interval_s=0.1,
+            health_debounce=2,
+            max_attempts=3,
+            proxy_timeout_s=30.0,
+            ready_timeout_s=60.0,
+            stats_interval_s=0.2,
+        )
+        killed = []
+
+        def on_arrival(i):
+            # the flash burst occupies the middle sixth of the nominal
+            # run; arrival ~300 of 700 sits inside it
+            if not killed and i >= 300:
+                killed.append(True)
+                fleet["procs"][0].send_signal(signal.SIGTERM)
+
+        res = run_serve_fleet(cfg, on_arrival=on_arrival)
+        v = res["verdict"]
+        assert killed, "the kill hook never fired"
+        assert v["serve_verdict"] == 6
+        # zero client-visible drops across the host death: every
+        # request got SOME response — 200 or an explicit shed
+        assert v["client"]["dropped"] == 0
+        assert v["client"]["responses"] == v["client"]["submitted"]
+        flt = v["fleet"]
+        assert flt["dropped"] == 0
+        # per-host ledgers sum to the client totals — computed inside
+        # the verdict AND re-derived here
+        assert flt["ledger_consistent"] is True
+        assert flt["completed_total"] == (
+            v["client"]["by_status"].get("200", 0)
+        )
+        assert flt["completed_total"] == sum(
+            h["completed"] for h in flt["hosts"].values()
+        )
+        assert flt["completed_total"] == v["requests_completed"]
+        # both hosts served before the kill; the survivor carried the
+        # fleet after it
+        assert flt["hosts"]["h0"]["completed"] > 0
+        assert flt["hosts"]["h1"]["completed"] > 0
+        assert flt["hosts"]["h0"]["state"] in (
+            HOST_DRAINING, HOST_DEAD
+        )
+        # identity cross-check: the hosts advertised who they are
+        assert flt["hosts"]["h0"]["server_id"] == "h0"
+        assert flt["hosts"]["h1"]["server_id"] == "h1"
+        assert v["requests_failed"] == 0
+        assert v["drained_clean"] is True
+        # run-dir artifacts: verdict.json matches, fleet events flow
+        with open(os.path.join(res["run_dir"], "verdict.json")) as f:
+            assert json.load(f) == v
+        events = read_events(res["run_dir"])
+        kinds = {e["kind"] for e in events}
+        assert "fleet" in kinds and "serve" in kinds
+        fleet_phases = [
+            e["phase"] for e in events if e["kind"] == "fleet"
+        ]
+        assert fleet_phases[0] == "start"
+        assert "ready" in fleet_phases and "stats" in fleet_phases
+        assert "probe" in fleet_phases  # h0's state transitions
+        assert fleet_phases[-1] == "stop"
+        # watch renders the fleet banner; summarize carries the block
+        status = render_status(events, None)
+        assert "fleet:" in status
+        report, summary = summarize_run(res["run_dir"])
+        assert summary["serving"]["fleet"] is not None
+        assert summary["serving"]["verdict"]["fleet"][
+            "ledger_consistent"] is True
+        assert "fleet" in report
+        # the SIGTERMed host exited cleanly after ITS drain: rc 0 and
+        # its own run dir shows the drain latch
+        p0 = fleet["procs"][0]
+        try:
+            p0.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            pytest.fail("SIGTERMed host never exited")
+        assert p0.returncode == 0
+        host0_events = _host_events(fleet["roots"][0])
+        assert any(
+            e.get("kind") == "http" and e.get("phase") == "drain"
+            for e in host0_events
+        )
+        # compare: a doctored fleet-dropped regression exits 3
+        from bdbnn_tpu.cli import main as cli_main
+
+        doctored = dict(v)
+        doctored["fleet"] = {**flt, "dropped": 3}
+        cand = tmp_path / "doctored.json"
+        cand.write_text(json.dumps(doctored))
+        verdict_path = os.path.join(res["run_dir"], "verdict.json")
+        assert cli_main(
+            ["compare", verdict_path, str(cand), "--json"]
+        ) == 3
+
+
+@pytest.mark.slow
+class TestFleetSigkill:
+    """The SIGKILL variant: no drain on the victim — its in-flight
+    proxied requests die mid-exchange and MUST be answered by the
+    peer through the retry path."""
+
+    def test_sigkill_one_host_mid_flash_crowd(
+        self, exported_artifact, tmp_path_factory, tmp_path
+    ):
+        from conftest import retry_once_flaky
+
+        art_dir, _ = exported_artifact
+
+        def attempt(i):
+            tag = "fleet_kill" if i == 0 else "fleet_kill_retry"
+            roots = [
+                tmp_path_factory.mktemp(f"{tag}_h{j}")
+                for j in range(2)
+            ]
+            return _form_fleet(art_dir, roots)
+
+        procs, ports = retry_once_flaky(
+            attempt,
+            note=(
+                "fleet host cluster attempt 1 never formed "
+                "(serve-http subprocess bring-up transient — see "
+                "TestFleetEndToEnd.fleet)"
+            ),
+        )
+        try:
+            cfg = ServeFleetConfig(
+                hosts=tuple(f"127.0.0.1:{p}" for p in ports),
+                artifact=art_dir,
+                log_path=str(tmp_path / "fleet_run"),
+                scenario="flash_crowd",
+                rate=120.0,
+                requests=700,
+                concurrency=12,
+                seed=0,
+                probe_interval_s=0.1,
+                health_debounce=2,
+                max_attempts=3,
+                proxy_timeout_s=30.0,
+                stats_interval_s=0.2,
+            )
+            killed = []
+
+            def on_arrival(i):
+                if not killed and i >= 300:
+                    killed.append(True)
+                    procs[0].kill()  # SIGKILL: no drain, no goodbye
+
+            res = run_serve_fleet(cfg, on_arrival=on_arrival)
+            v = res["verdict"]
+            flt = v["fleet"]
+            assert v["client"]["dropped"] == 0
+            assert flt["dropped"] == 0
+            assert flt["ledger_consistent"] is True
+            # the kill produced real transport failures that were
+            # retried onto the peer — that is the whole point
+            h0 = flt["hosts"]["h0"]
+            assert (
+                h0["retries"]["reset"] + h0["retries"]["connect"]
+                + h0["retries"]["timeout"] > 0
+            )
+            assert h0["state"] == HOST_DEAD
+            assert flt["hosts"]["h1"]["completed"] > 0
+            assert v["requests_failed"] == 0
+        finally:
+            _reap_hosts(procs, timeout=30)
