@@ -60,6 +60,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--custom_resnet", action="store_true", default=True)
     p.add_argument("--reset_resume", action="store_true")
     p.add_argument("--ede", action="store_true")
+    p.add_argument(
+        "--binarizer", default="", metavar="FAMILY[:PARAM=V,...]",
+        help="binarizer family (nn/binarize.py registry): ste | approx "
+        "| ede | proximal[:delta0=,delta1=] | lab | stochastic — the "
+        "activation forward/backward quantizer x weight scale x "
+        "per-epoch schedule regime, validated at config time. Default "
+        "keeps the legacy mapping (--ede -> ede, else ste)",
+    )
     p.add_argument("--w-kurtosis-target", type=float, default=1.8)
     p.add_argument("--w-lambda-kurtosis", type=float, default=1.0)
     p.add_argument("--w-kurtosis", action="store_true")
@@ -275,6 +283,7 @@ def args_to_config(args: argparse.Namespace) -> RunConfig:
         evaluate=args.evaluate,
         seed=args.seed,
         ede=args.ede,
+        binarizer=args.binarizer,
         w_kurtosis=args.w_kurtosis,
         w_kurtosis_target=args.w_kurtosis_target,
         w_lambda_kurtosis=args.w_lambda_kurtosis,
@@ -1316,6 +1325,140 @@ def serve_fleet_main(argv) -> int:
     return 0
 
 
+def search_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli search --out-dir SWEEP [flags]`` — the
+    preemption-resilient recipe-search harness (bdbnn_tpu/search/):
+    a trial grid (binarizer families x learning rates, or an explicit
+    ``--trial FAMILY@LR`` list) fans out short budgeted ``fit()`` runs
+    as real CLI subprocesses (sequentially or ``--workers`` N-way),
+    each a full run dir riding the resilience layer — SIGTERM on the
+    harness forwards to every in-flight worker, which checkpoints
+    mid-epoch and exits 75; the harness records the cursors in the
+    integrity-digested trial ledger and exits 75 itself. ``--resume``
+    continues the sweep: completed trials never re-run, preempted
+    trials resume from their checkpoints. The finished sweep lands as
+    a deterministic strict-JSON leaderboard (winner, per-trial
+    best/final top-1, time-to-common-accuracy, alerts) that `compare`
+    judges and `watch`/`summarize` render. Exit codes: 0 complete, 75
+    preempted (resume me), 1 when any trial failed."""
+    import json
+
+    from bdbnn_tpu.configs.config import SearchConfig
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli search",
+        description="Sweep binarizer-family recipes with short "
+        "budgeted trials; rank them into a leaderboard verdict.",
+    )
+    ap.add_argument("data", nargs="?", default="", help="dataset dir")
+    ap.add_argument(
+        "--out-dir", required=True,
+        help="sweep dir (trial ledger + events + leaderboard)",
+    )
+    ap.add_argument(
+        "--families", nargs="+", default=["ste", "ede"],
+        metavar="FAMILY[:PARAM=V,...]",
+        help="binarizer families of the trial grid (default: ste ede)",
+    )
+    ap.add_argument(
+        "--lrs", type=float, nargs="+", default=[0.1],
+        help="learning rates of the trial grid (default: 0.1)",
+    )
+    ap.add_argument(
+        "--trial", action="append", default=[], dest="trials",
+        metavar="FAMILY[:PARAM=V,...]@LR",
+        help="explicit trial (repeatable; REPLACES the families x lrs "
+        "grid)",
+    )
+    ap.add_argument("--dataset", default="cifar10",
+                    choices=["cifar10", "cifar100", "imagenet"])
+    ap.add_argument("-a", "--arch", default="resnet20")
+    ap.add_argument("--epochs", type=int, default=1,
+                    help="per-trial training budget (default 1)")
+    ap.add_argument("-b", "--batch-size", type=int, default=64)
+    ap.add_argument("-p", "--print-freq", type=int, default=10)
+    ap.add_argument("--synthetic", action="store_true",
+                    help="trials train on random tensors (smoke sweeps)")
+    ap.add_argument("--synthetic-train-size", type=int, default=2048)
+    ap.add_argument("--synthetic-val-size", type=int, default=512)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="shared seed — every trial runs the same data "
+                    "stream so the leaderboard compares recipes only")
+    ap.add_argument(
+        "--workers", type=int, default=1,
+        help="trial subprocesses in flight at once (default 1)",
+    )
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="continue an interrupted sweep in --out-dir: completed "
+        "trials are never re-run, preempted trials resume from their "
+        "mid-epoch checkpoints",
+    )
+    ap.add_argument(
+        "--out", default="",
+        help="also write the leaderboard JSON here",
+    )
+    ap.add_argument("--events-max-mb", type=float, default=256.0)
+    args = ap.parse_args(argv)
+
+    from bdbnn_tpu.search import run_search
+    from bdbnn_tpu.train.resilience import PREEMPT_EXIT_CODE, PreemptedError
+
+    cfg = SearchConfig(
+        out_dir=args.out_dir,
+        data=args.data,
+        families=tuple(args.families),
+        lrs=tuple(args.lrs),
+        trials=tuple(args.trials),
+        dataset=args.dataset,
+        arch=args.arch,
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        print_freq=args.print_freq,
+        synthetic=args.synthetic,
+        synthetic_train_size=args.synthetic_train_size,
+        synthetic_val_size=args.synthetic_val_size,
+        seed=args.seed,
+        workers=args.workers,
+        resume=args.resume,
+        out=args.out,
+        events_max_mb=args.events_max_mb,
+    )
+    try:
+        result = run_search(cfg)
+    except PreemptedError as e:
+        print(
+            f"[search] preempted by signal {e.signum}; in-flight "
+            "trials checkpointed and the ledger recorded their "
+            f"cursors — restart with --resume --out-dir "
+            f"{args.out_dir} to continue the sweep.",
+            file=sys.stderr,
+        )
+        return PREEMPT_EXIT_CODE
+    print(json.dumps(result["leaderboard"], indent=2, sort_keys=True))
+    print(f"[search] sweep dir: {result['sweep_dir']}", file=sys.stderr)
+    if result["failed"]:
+        print(
+            f"[search] {result['failed']} trial(s) FAILED (not "
+            "preempted); see the sweep dir's events and worker logs",
+            file=sys.stderr,
+        )
+        return 1
+    lb = result["leaderboard"]
+    if (lb.get("completed") or 0) < (lb.get("trials_total") or 0):
+        # belt over the harness's re-enqueue braces: a sweep that ends
+        # with trials neither done nor failed must not read as a
+        # complete leaderboard
+        print(
+            f"[search] sweep INCOMPLETE: {lb.get('completed')}/"
+            f"{lb.get('trials_total')} trial(s) completed; see the "
+            "ledger",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def check_main(argv) -> int:
     """``python -m bdbnn_tpu.cli check [--json] [--checker ID]`` — the
     project-native static analyzer (bdbnn_tpu/analysis/): lock
@@ -1485,6 +1628,7 @@ _SUBCOMMANDS = {
     "serve-http": serve_http_main,
     "serve-fleet": serve_fleet_main,
     "registry": registry_main,
+    "search": search_main,
     "check": check_main,
 }
 

@@ -1,4 +1,4 @@
-"""Binarization primitives as ``jax.custom_vjp`` transforms.
+"""Binarization primitives + the binarizer-family registry.
 
 The reference (BlueAnon/BD-BNN) implements these inside a ``models/``
 package that is absent from its snapshot; their behavior is recoverable
@@ -16,17 +16,76 @@ and the IR-Net / Bi-Real / ReActNet lineage the paper builds on:
                         every conv module (``train.py:412-415``); here
                         they are traced scalar arguments so the jitted
                         step never retraces across epochs.
+- ``prox_sign``       — sign forward, proximal-quantizer backward
+                        (arXiv:2402.17710 forward/backward prox pairs):
+                        the derivative of the piecewise-quadratic
+                        proximal envelope, (2/δ)·max(0, 1 − |x|/δ) — a
+                        unit-mass tent that equals the Bi-Real
+                        polynomial at δ = 1 and sharpens toward the
+                        true (zero a.e.) derivative as δ → 0. δ is a
+                        traced scalar, annealed per epoch like EDE's
+                        (t, k).
+- ``stoch_sign``      — BinaryNet stochastic binarization
+                        (arXiv:1602.02830 §1.1): forward samples ±1
+                        with P(+1) = hard-sigmoid((x+1)/2) from an
+                        explicit uniform draw (``jax.random`` — the
+                        jit-purity analyzer bans ``np.random``),
+                        backward is the clipped-identity STE.
 - ``binarize_weight`` — XNOR-Net/ReActNet-style magnitude-aware weight
                         binarization: sign(W) scaled by the per-output-
                         channel mean |W| (scale detached), with a
                         clipped-identity STE into the latent weights.
 
-All forwards use sign(x in {-1, +1}) with sign(0) := +1 — the binary-CNN
-convention (torch.sign's 0 would create a third value and break the
-±1 algebra of XNOR convolutions).
+All deterministic forwards use sign(x in {-1, +1}) with sign(0) := +1 —
+the binary-CNN convention (torch.sign's 0 would create a third value
+and break the ±1 algebra of XNOR convolutions).
+
+**Family registry.** A *binarizer family* bundles one coherent regime:
+activation forward quantizer × backward estimator × weight scale × an
+optional per-epoch schedule whose values enter the jitted step as
+TRACED scalars (the EDE discipline — annealing never retraces). The
+registry makes every regime a config flag (``--binarizer
+FAMILY[:PARAM=V,...]``) instead of a fork:
+
+========== ============================ ========================== =========
+family     act forward/backward         weight scale alpha         schedule
+========== ============================ ========================== =========
+ste        sign / clipped identity      mean|W| per out-channel    —
+approx     sign / 2−2|x| (Bi-Real)      mean|W|                    —
+ede        sign / k·t·sech²(t·x)        mean|W|                    (t, k)
+proximal   sign / (2/δ)(1−|x|/δ)₊       mean|W|                    (δ,)
+lab        sign / clipped identity      E[W²]/E[|W|] (loss-aware)  —
+stochastic bernoulli(σ̂(x)) / clipped id mean|W|                    —
+========== ============================ ========================== =========
+
+Citations: ste+stochastic arXiv:1602.02830 (BinaryNet deterministic /
+stochastic pair), approx arXiv:1808.00278 (Bi-Real Net), ede
+arXiv:1909.10788 (IR-Net), proximal arXiv:2402.17710 (ProxConnect++
+forward/backward proximal quantizers), lab arXiv:1611.01600
+(loss-aware binarization — the diagonal-curvature-weighted optimal
+scale ``alpha* = ||d∘W||₁/||d||₁`` with the self-magnitude proxy
+``d = |W|``, giving ``alpha = Σ W²/Σ|W|`` per output channel).
+
+The DEFAULT family (``ste``; ``--ede`` resolves to ``ede``) routes
+through exactly the pre-registry functions — bitwise-equal params and
+eval logits on a fixed-seed fit are pinned in tier-1
+(tests/test_binarize.py, tests/test_train.py). Weight binarization
+keeps the magnitude-aware STE in every family (the reference applies
+EDE to activations only; same convention here), so the export fixed
+point ``mean|sign·alpha| == alpha`` holds for any family.
+
+The active family is a process-global trace-time constant (the
+``nn.packed.set_packed_impl`` pattern): ``fit()`` sets it from the
+validated config before the model is built; schedule VALUES stay
+traced arguments, so one compiled step serves the whole run.
 """
 
 from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Dict, Mapping, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -181,3 +240,327 @@ def binarize_act(x: Array, *, estimator: str = "ste", tk=None) -> Array:
     if estimator == "approx":
         return approx_sign(x)
     raise ValueError(f"unknown estimator: {estimator!r}")
+
+
+# ---------------------------------------------------------------------------
+# Proximal sign (forward/backward proximal quantizers, arXiv:2402.17710)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def prox_sign(x: Array, delta: Array) -> Array:
+    """sign(x) with the proximal-envelope backward (2/δ)·max(0, 1−|x|/δ).
+
+    The backward is the derivative of the piecewise-quadratic proximal
+    envelope of the sign constraint (the ProxConnect forward/backward
+    quantizer pairing, arXiv:2402.17710): a tent of unit mass
+    (∫ dx = 2 independent of δ — the same mass the clipped-identity STE
+    passes over [-1, 1]) that reproduces Bi-Real's 2−2|x| at δ = 1 and
+    concentrates toward the true (zero a.e.) derivative as δ → 0.
+
+    ``delta`` is a traced scalar (the ``proximal`` family anneals it
+    per epoch, δ₀ → δ₁ log-linearly — the EDE discipline): changing it
+    across epochs never retraces the jitted step.
+    """
+    del delta
+    return _hard_sign(x)
+
+
+def _prox_sign_fwd(x, delta):
+    return _hard_sign(x), (x, delta)
+
+
+def _prox_sign_bwd(res, g):
+    x, delta = res
+    d = delta.astype(g.dtype)
+    slope = (2.0 / d) * jnp.clip(1.0 - jnp.abs(x) / d, 0.0, None)
+    return g * slope.astype(g.dtype), jnp.zeros_like(delta)
+
+
+prox_sign.defvjp(_prox_sign_fwd, _prox_sign_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Stochastic sign (BinaryNet stochastic binarization, arXiv:1602.02830)
+# ---------------------------------------------------------------------------
+
+
+def hard_sigmoid(x: Array) -> Array:
+    """clip((x+1)/2, 0, 1) — BinaryNet's σ̂, the P(+1) of the
+    stochastic binarizer. E[stoch_sign(x)] = 2σ̂(x) − 1 = clip(x, −1, 1),
+    which equals hard sign wherever |x| >= 1."""
+    return jnp.clip((x + 1.0) * 0.5, 0.0, 1.0)
+
+
+@jax.custom_vjp
+def stoch_sign(x: Array, u: Array) -> Array:
+    """±1 sampled with P(+1) = hard_sigmoid(x) from the uniform draw
+    ``u`` ∈ [0, 1); backward is the clipped-identity STE (BinaryNet
+    backpropagates through the expectation's hard-sigmoid envelope).
+
+    The randomness is an EXPLICIT operand: callers draw ``u`` with
+    ``jax.random`` from a key derived from (seed, step, module path),
+    so the sampled forward is a pure function of its inputs — resuming
+    a preempted run at the same step replays the same masks bitwise
+    (and the jit-purity analyzer's np.random ban stays satisfied).
+    At |x| >= 1 the sample is deterministic (P(+1) ∈ {0, 1}); without
+    a key (eval / serving) the family falls back to the deterministic
+    hard sign, BinaryNet's test-time convention.
+    """
+    p = hard_sigmoid(x)
+    return jnp.where(u < p, 1.0, -1.0).astype(x.dtype)
+
+
+def _stoch_sign_fwd(x, u):
+    p = hard_sigmoid(x)
+    y = jnp.where(u < p, 1.0, -1.0).astype(x.dtype)
+    return y, (x, u)
+
+
+def _stoch_sign_bwd(res, g):
+    x, u = res
+    return g * (jnp.abs(x) <= 1.0).astype(g.dtype), jnp.zeros_like(u)
+
+
+stoch_sign.defvjp(_stoch_sign_fwd, _stoch_sign_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Binarizer-family registry
+# ---------------------------------------------------------------------------
+
+# family name -> (citation, stochastic, schedule length, param defaults).
+# Params are the family's tunable hyperparameters, overridable in the
+# config spec (``--binarizer proximal:delta0=1.5,delta1=0.25``) and
+# validated at parse time.
+_FAMILY_TABLE: Dict[str, Tuple[str, bool, int, Tuple[Tuple[str, float], ...]]] = {
+    "ste": ("arXiv:1602.02830", False, 0, ()),
+    "approx": ("arXiv:1808.00278", False, 0, ()),
+    "ede": ("arXiv:1909.10788", False, 2, ()),
+    "proximal": (
+        "arXiv:2402.17710", False, 1,
+        (("delta0", 2.0), ("delta1", 0.5)),
+    ),
+    "lab": ("arXiv:1611.01600", False, 0, ()),
+    "stochastic": ("arXiv:1602.02830", True, 0, ()),
+}
+
+FAMILY_NAMES: Tuple[str, ...] = tuple(sorted(_FAMILY_TABLE))
+
+
+def parse_binarizer(spec: str) -> Tuple[str, Dict[str, float]]:
+    """Parse ``FAMILY[:PARAM=V,...]`` into ``(name, params)``, raising
+    ``ValueError`` on unknown families, unknown params or unparseable
+    values — config-time failures, never mid-run."""
+    name, _, tail = spec.partition(":")
+    name = name.strip()
+    if name not in _FAMILY_TABLE:
+        raise ValueError(
+            f"unknown binarizer family {name!r} "
+            f"(known: {', '.join(FAMILY_NAMES)})"
+        )
+    defaults = dict(_FAMILY_TABLE[name][3])
+    params = dict(defaults)
+    if tail:
+        for item in tail.split(","):
+            key, sep, val = item.partition("=")
+            key = key.strip()
+            if not sep or not key:
+                raise ValueError(
+                    f"bad binarizer param {item!r} in {spec!r} "
+                    "(want PARAM=VALUE)"
+                )
+            if key not in defaults:
+                raise ValueError(
+                    f"binarizer family {name!r} has no param {key!r} "
+                    f"(known: {sorted(defaults) or 'none'})"
+                )
+            try:
+                params[key] = float(val)
+            except ValueError as e:
+                raise ValueError(
+                    f"binarizer param {key}={val!r} is not a number"
+                ) from e
+            if params[key] <= 0:
+                raise ValueError(
+                    f"binarizer param {key} must be > 0, got {params[key]}"
+                )
+    return name, params
+
+
+@dataclasses.dataclass(frozen=True)
+class BinarizerFamily:
+    """One registered binarization regime (see the module docstring's
+    family table). Frozen + hashable: the step config embeds the
+    family's identity, and the activation/weight methods are traced
+    into the jitted step as trace-time constants."""
+
+    name: str
+    citation: str
+    stochastic: bool
+    schedule_len: int
+    params: Tuple[Tuple[str, float], ...] = ()
+
+    def param(self, key: str) -> float:
+        return dict(self.params)[key]
+
+    @property
+    def spec(self) -> str:
+        """The canonical config-spec string (name + non-default params)."""
+        defaults = dict(_FAMILY_TABLE[self.name][3])
+        overrides = [
+            f"{k}={v:g}" for k, v in self.params if defaults.get(k) != v
+        ]
+        return self.name + (":" + ",".join(overrides) if overrides else "")
+
+    # -- per-epoch schedule (host-side; values become traced scalars) --
+
+    def schedule(self, epoch: int, total_epochs: int) -> Tuple[float, ...]:
+        """Schedule values entering ``epoch`` of a ``total_epochs`` run
+        — () for schedule-free families. Pure host math, recorded in
+        checkpoint/restore events so resume's schedule position is
+        auditable bitwise."""
+        if self.name == "ede":
+            from bdbnn_tpu.train.ede import cpt_tk
+
+            return cpt_tk(epoch, total_epochs)
+        if self.name == "proximal":
+            lo = math.log10(self.param("delta0"))
+            hi = math.log10(self.param("delta1"))
+            return (10.0 ** (lo + (hi - lo) / total_epochs * epoch),)
+        return ()
+
+    # -- activation binarization (traced) --
+
+    def binarize_act(
+        self, x: Array, sched=None, rng: Optional[Array] = None
+    ) -> Array:
+        """Family-dispatched activation binarizer. ``sched`` carries
+        the traced schedule scalars (None on the eval path — schedule
+        families fall back to the plain STE sign there, matching the
+        legacy eval forward bitwise); ``rng`` is the per-call
+        ``jax.random`` key the stochastic family samples from (None =
+        deterministic hard sign, BinaryNet's test-time convention)."""
+        if self.name in ("ste", "ede") and sched is not None:
+            # legacy contract, kept bitwise: a (t, k) pair handed to the
+            # default family switches to the EDE estimator — exactly the
+            # old ``binarize_act(x, tk=tk)`` dispatch, so direct
+            # ``model.apply(..., tk=...)`` callers (bench harnesses,
+            # tests) behave as before the registry
+            t, k = sched
+            return ede_sign(
+                x, jnp.asarray(t, x.dtype), jnp.asarray(k, x.dtype)
+            )
+        if self.name == "proximal" and sched is not None:
+            (delta,) = sched
+            return prox_sign(x, jnp.asarray(delta, x.dtype))
+        if self.name == "approx":
+            return approx_sign(x)
+        if self.name == "stochastic" and rng is not None:
+            u = jax.random.uniform(rng, jnp.shape(x), x.dtype)
+            return stoch_sign(x, u)
+        return ste_sign(x)
+
+    # -- weight binarization (traced) --
+
+    def weight_sign(self, w: Array) -> Array:
+        """±1 weight sign with the magnitude-aware STE backward — every
+        family keeps the clipped-identity estimator into the latent
+        weights (the reference applies its annealed estimators to
+        activations only)."""
+        return ste_sign(w)
+
+    def weight_alpha(self, w: Array) -> Array:
+        """Per-output-channel scale (callers detach it). Default:
+        XNOR/ReActNet mean|W|. ``lab``: the loss-aware optimal scale
+        ``||d∘W||₁/||d||₁`` (arXiv:1611.01600's closed-form per-layer
+        solution, diagonal curvature ``d``) with the self-magnitude
+        proxy ``d = |W|`` → ``ΣW²/Σ|W|``."""
+        reduce_axes = tuple(range(w.ndim - 1))
+        if self.name == "lab":
+            return jnp.mean(w * w, axis=reduce_axes) / (
+                jnp.mean(jnp.abs(w), axis=reduce_axes) + 1e-12
+            )
+        return jnp.mean(jnp.abs(w), axis=reduce_axes)
+
+
+def weight_alpha_np(name: str, w):
+    """Host (numpy) twin of :meth:`BinarizerFamily.weight_alpha` — the
+    exporter binarizes ONCE on the host with the family the run
+    trained under, so the frozen artifact's alpha matches the training
+    eval forward. Returns float32."""
+    import numpy as np
+
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    if name == "lab":
+        return (
+            np.mean(w * w, axis=reduce_axes)
+            / (np.mean(np.abs(w), axis=reduce_axes) + 1e-12)
+        ).astype(np.float32)
+    return np.mean(np.abs(w), axis=reduce_axes).astype(np.float32)
+
+
+def make_family(
+    name: str, params: Optional[Mapping[str, float]] = None
+) -> BinarizerFamily:
+    citation, stochastic, sched_len, defaults = _FAMILY_TABLE[name]
+    merged = dict(defaults)
+    merged.update(params or {})
+    return BinarizerFamily(
+        name=name,
+        citation=citation,
+        stochastic=stochastic,
+        schedule_len=sched_len,
+        params=tuple(sorted(merged.items())),
+    )
+
+
+def resolve_family(spec: str = "", *, ede: bool = False) -> BinarizerFamily:
+    """Resolve a config's ``(binarizer, ede)`` pair to a family.
+
+    An empty spec keeps the legacy mapping — ``ede`` when ``--ede``,
+    else the default ``ste``. A non-empty spec must agree with the
+    ``--ede`` flag (``--ede --binarizer proximal`` is two different
+    regimes; refuse at config time)."""
+    if not spec:
+        return make_family("ede" if ede else "ste")
+    name, params = parse_binarizer(spec)
+    if ede and name != "ede":
+        raise ValueError(
+            f"--ede selects the 'ede' binarizer family but --binarizer "
+            f"names {name!r}; drop --ede or use --binarizer ede"
+        )
+    return make_family(name, params)
+
+
+# the process-global active family: a TRACE-TIME constant (the
+# nn.packed.set_packed_impl pattern) — fit() sets it from the validated
+# config before any model is built; per-epoch schedule VALUES remain
+# traced arguments, so the setting never retraces a compiled step.
+_ACTIVE_FAMILY: BinarizerFamily = make_family("ste")
+
+
+def set_active_family(family) -> BinarizerFamily:
+    """Install the active family (a :class:`BinarizerFamily` or a spec
+    string); returns the installed family."""
+    global _ACTIVE_FAMILY
+    if isinstance(family, str):
+        family = make_family(*parse_binarizer(family))
+    _ACTIVE_FAMILY = family
+    return family
+
+
+def get_active_family() -> BinarizerFamily:
+    return _ACTIVE_FAMILY
+
+
+@contextlib.contextmanager
+def active_family(family):
+    """Scoped family install for tests — restores the previous family
+    on exit so one test's regime never leaks into the next."""
+    prev = get_active_family()
+    try:
+        yield set_active_family(family)
+    finally:
+        set_active_family(prev)

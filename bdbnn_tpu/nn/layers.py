@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from bdbnn_tpu.nn.binarize import approx_sign, binarize_act, ste_sign
+from bdbnn_tpu.nn.binarize import approx_sign, get_active_family
 from bdbnn_tpu.nn.kernels import binary_conv2d_mxu
 
 Array = jax.Array
@@ -105,6 +105,23 @@ class _BinaryConvBase(nn.Module):
             "float_weight", nn.initializers.he_normal(), shape
         )
 
+    def family_act(self, x: Array, tk=None) -> Array:
+        """Input binarization routed through the active family:
+        ``tk`` carries the family's traced schedule scalars ((t, k)
+        for ede, (δ,) for proximal; None on schedule-free families and
+        the eval path). The stochastic family samples from the
+        ``binarize`` rng stream when the caller threaded one (the
+        train step's per-step key, folded per module path by flax) and
+        falls back to the deterministic hard sign otherwise — eval and
+        serving never sample."""
+        fam = get_active_family()
+        rng = (
+            self.make_rng("binarize")
+            if fam.stochastic and self.has_rng("binarize")
+            else None
+        )
+        return fam.binarize_act(x, sched=tk, rng=rng)
+
     def binary_conv(self, xb: Array, in_features: int) -> Array:
         """±alpha binary conv, routed through
         :func:`bdbnn_tpu.nn.kernels.binary_conv2d_mxu` — the stock XLA
@@ -128,6 +145,16 @@ class _BinaryConvBase(nn.Module):
         The ``binarize`` / ``binary_conv`` named scopes land in XLA op
         metadata so device trace events attribute to stable semantic
         categories (obs/trace.py DEVICE_SPANS) instead of fusion names.
+
+        **Family routing.** The weight sign estimator and the per-
+        channel alpha come from the ACTIVE binarizer family
+        (nn/binarize.py registry — a trace-time constant fit() installs
+        from the config). The default family reproduces the
+        pre-registry path bitwise: ``ste_sign`` + detached ``mean|W|``.
+        Families differ only in the alpha formula (``lab``) and the
+        activation estimator — the export fixed point
+        ``mean|sign·alpha| == alpha`` holds for every family, so the
+        packed serving path stays family-invariant.
         """
         from bdbnn_tpu.nn.packed import (
             PACKED_COLLECTION,
@@ -142,6 +169,7 @@ class _BinaryConvBase(nn.Module):
                 self.get_variable(PACKED_COLLECTION, "sign"),
                 self.get_variable(PACKED_COLLECTION, "alpha"),
             )
+        fam = get_active_family()
         with jax.named_scope("binarize"):
             if packed is not None:
                 shape = (*self.kernel_size, in_features, self.features)
@@ -151,11 +179,8 @@ class _BinaryConvBase(nn.Module):
                     ).astype(xb.dtype)
             else:
                 w = self.latent_weight(in_features).astype(xb.dtype)
-            signed = ste_sign(w)
-            reduce_axes = tuple(range(w.ndim - 1))
-            alpha = jax.lax.stop_gradient(
-                jnp.mean(jnp.abs(w), axis=reduce_axes)
-            )
+            signed = fam.weight_sign(w)
+            alpha = jax.lax.stop_gradient(fam.weight_alpha(w))
         with jax.named_scope("binary_conv"):
             if packed is not None and get_packed_impl() == "popcount":
                 return popcount_binary_conv(
@@ -184,25 +209,28 @@ class BinaryConvReact(_BinaryConvBase):
 
 
 class BinaryConv(_BinaryConvBase):
-    """Plain-STE binary conv ("step 2" variant ↔ reference
-    ``HardBinaryConv``, imported at ``train.py:31``)."""
+    """Binary conv with family-routed input binarization ("step 2"
+    variant ↔ reference ``HardBinaryConv``, imported at ``train.py:31``;
+    plain STE under the default family)."""
 
     @nn.compact
     def __call__(self, x: Array, tk=None) -> Array:
         with jax.named_scope("binarize"):
-            xb = binarize_act(x, estimator="ste", tk=tk)
+            xb = self.family_act(x, tk)
         return self.binary_conv(xb, x.shape[-1])
 
 
 class BinaryConvCifar(_BinaryConvBase):
     """CIFAR binary conv (↔ reference ``HardBinaryConv_cifar``,
-    ``train.py:32``). Accepts ``tk=(t, k)`` to switch the input
-    estimator to the annealed EDE under ``--ede``."""
+    ``train.py:32``). ``tk`` carries the active family's traced
+    schedule scalars — (t, k) under ``--ede`` (↔ the reference pushing
+    ``.k``/``.t`` onto conv modules per epoch), (δ,) under the
+    proximal family."""
 
     @nn.compact
     def __call__(self, x: Array, tk=None) -> Array:
         with jax.named_scope("binarize"):
-            xb = binarize_act(x, estimator="ste", tk=tk)
+            xb = self.family_act(x, tk)
         return self.binary_conv(xb, x.shape[-1])
 
 

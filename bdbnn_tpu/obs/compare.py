@@ -46,6 +46,11 @@ RECIPE_FIELDS: Tuple[str, ...] = (
     "arch", "dataset", "ede", "w_kurtosis", "w_kurtosis_target",
     "kurtosis_mode", "imagenet_setting_step_2_ts", "react", "twoblock",
     "dtype", "batch_size", "epochs", "lr", "opt_policy",
+    # the binarizer family spec (nn/binarize.py registry; config
+    # validate() canonicalizes it, so "ste" vs "proximal:delta1=0.25"
+    # runs never silently compare as same-recipe). Pre-registry
+    # manifests lack the key -> None -> never a mismatch.
+    "binarizer",
 )
 
 # metric -> (direction, tolerance kind). Directions: "higher" is
@@ -141,6 +146,14 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("serve_fleet_dropped", "lower", "count"),
     ("serve_fleet_retry_rate", "lower", "rel"),
     ("serve_fleet_host_p99_spread", "lower", "rel"),
+    # recipe-search leaderboards (bdbnn_tpu/search/): the winning
+    # trial's best top-1 (absolute pp tolerance, like the training
+    # accuracies) and its time to the sweep's common-accuracy level —
+    # the same time-to-common-accuracy judgment compare applies
+    # run-vs-run, here sweep-vs-sweep. Non-search sources leave both
+    # None, so they skip cleanly in both directions.
+    ("search_best_top1", "higher", "acc"),
+    ("search_time_to_common_acc_s", "lower", "rel"),
 )
 
 # serve-verdict field -> compare metric name (flat v1 aggregates)
@@ -246,6 +259,21 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
             (swap.get("shed") or 0) + dropped + not_performed
         )
     return out
+
+def _search_metrics(leaderboard: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten one recipe-search leaderboard (bdbnn_tpu/search/) into
+    the compare metric namespace — shared by the sweep-dir and
+    leaderboard-artifact extraction paths. A sweep with no completed
+    trial has no winner: both metrics stay None (skipped), never a
+    fabricated 0."""
+    winner = leaderboard.get("winner") or {}
+    return {
+        "search_best_top1": winner.get("best_top1"),
+        "search_time_to_common_acc_s": winner.get(
+            "time_to_common_acc_s"
+        ),
+    }
+
 
 # the metric-key skeleton every extracted source carries (None = the
 # source does not know this metric; _judge skips it). time_to_common_acc
@@ -355,11 +383,26 @@ def _extract_run_dir(path: str) -> Dict[str, Any]:
     serve_verdict = serve_digest(events)["verdict"]
     if serve_verdict is not None:
         metrics.update(_serve_metrics(serve_verdict))
+    # a recipe-search sweep dir: the final `search` verdict event
+    # carries the leaderboard (bdbnn_tpu/search/); judged on the
+    # winner's metrics, aligned on the sweep's shared recipe
+    search_verdict = next(
+        (
+            e for e in reversed(events)
+            if e.get("kind") == "search" and e.get("phase") == "verdict"
+        ),
+        None,
+    )
+    if search_verdict is not None:
+        metrics.update(_search_metrics(search_verdict))
+    fmt = "run_dir"
+    if serve_verdict is not None:
+        fmt = "serve_run_dir"
+    elif search_verdict is not None:
+        fmt = "search_run_dir"
     return {
         "source": path,
-        "format": (
-            "serve_run_dir" if serve_verdict is not None else "run_dir"
-        ),
+        "format": fmt,
         "provenance": {
             "config_hash": manifest.get("config_hash"),
             "device_kind": manifest.get("device_kind"),
@@ -382,6 +425,24 @@ def _extract_artifact(path: str) -> Dict[str, Any]:
         return {
             "source": path,
             "format": "serve_verdict",
+            "provenance": {
+                "config_hash": prov.get("config_hash"),
+                "device_kind": None,
+                "recipe": _recipe_from_config(prov.get("recipe") or {}),
+            },
+            "metrics": metrics,
+            "acc_curve": [],
+        }
+    if "search_verdict" in d:
+        # a recipe-search leaderboard JSON (bdbnn_tpu/search/): judged
+        # on the winner's best top-1 + time-to-common-accuracy,
+        # aligned on the sweep's shared recipe provenance
+        prov = d.get("provenance") or {}
+        metrics = dict(_EMPTY_METRICS)
+        metrics.update(_search_metrics(d))
+        return {
+            "source": path,
+            "format": "search_leaderboard",
             "provenance": {
                 "config_hash": prov.get("config_hash"),
                 "device_kind": None,
@@ -438,7 +499,7 @@ def _extract_artifact(path: str) -> Dict[str, Any]:
     raise ValueError(
         f"{path!r}: not a recognized artifact (want a BENCH_*.json "
         "'parsed' bench line, an ACCURACY_*.json with best_val_top1, "
-        "or a serve-bench verdict.json)"
+        "a serve-bench verdict.json, or a search leaderboard.json)"
     )
 
 

@@ -173,6 +173,30 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
   reconciliation identity and tail-exemplar table land in the v4 SLO
   verdict's ``attribution`` block, not in events
 
+The recipe-search harness (``bdbnn_tpu/search/``) adds two:
+
+- ``search``      — one sweep's lifecycle (search/harness.py),
+  disambiguated by ``phase``: ``start``/``resume`` (trial count,
+  families, worker fan-out, the sweep config hash the ledger pins),
+  ``preempted`` (a SIGTERM/SIGINT was forwarded to every in-flight
+  trial worker, each checkpointed + exited 75, the ledger recorded
+  their cursors — the harness exits 75 next; ``completed`` counts the
+  trials already done, which ``--resume`` will never re-run) and
+  ``verdict`` (the final leaderboard: deterministic ranking by
+  best/final top-1, winner, time-to-common-accuracy, per-trial
+  status/attempts table — what ``compare`` judges as
+  ``search_best_top1``/``search_time_to_common_acc_s`` and
+  ``summarize`` renders as the leaderboard section)
+- ``trial``       — one trial's transitions (search/harness.py),
+  disambiguated by ``phase``: ``start`` (family spec, lr, attempt),
+  ``resumed`` (a preempted trial relaunched with ``--resume`` against
+  its recorded run dir), ``done`` (best/final top-1 + wall seconds +
+  the resolved run dir), ``preempted`` (the forwarded signal landed;
+  a mid-epoch checkpoint exists), ``interrupted`` (the signal caught
+  the worker before its first checkpoint — the attempt is lost, the
+  trial returns to pending, NOT a failure) and ``failed`` (nonzero
+  exit that was not a preemption; the worker log has the autopsy)
+
 The static analyzer adds one more:
 
 - ``analysis``    — one ``check`` CLI run's verdict (bdbnn_tpu/
@@ -240,6 +264,8 @@ KNOWN_KINDS = frozenset(
         "rtrace",
         "canary",
         "shadow",
+        "search",
+        "trial",
         "analysis",
     }
 )

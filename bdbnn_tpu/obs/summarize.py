@@ -368,6 +368,65 @@ def _serving(events) -> Optional[Dict[str, Any]]:
     }
 
 
+def _search(events) -> Optional[Dict[str, Any]]:
+    """The recipe-search section (bdbnn_tpu/search/): the sweep's
+    leaderboard verdict when one landed, otherwise the live trial
+    states, plus the resumed-trial lineage (which trials survived a
+    preemption and how many attempts they took). None when the
+    timeline carries no search telemetry."""
+    from bdbnn_tpu.search.harness import search_digest
+
+    digest = search_digest(events)
+    if digest["start"] is None and digest["verdict"] is None:
+        return None
+    verdict = digest["verdict"]
+    start = digest["start"] or {}
+    out: Dict[str, Any] = {
+        "trials_total": (verdict or start).get("trials_total"),
+        "families": start.get("families"),
+        "workers": start.get("workers"),
+        "preempted": digest["preempted"] is not None,
+    }
+    if verdict is not None:
+        trials = verdict.get("trials") or {}
+        out.update({
+            "completed": verdict.get("completed"),
+            "failed": verdict.get("failed"),
+            "common_acc_level": verdict.get("common_acc_level"),
+            "ranking": verdict.get("ranking"),
+            "winner": verdict.get("winner"),
+            "trials": trials,
+            # resumed-trial lineage: the trials that crossed a
+            # preemption (attempts > 1) — the evidence `--resume`
+            # continued rather than restarted the sweep
+            "resumed_trials": {
+                tid: {
+                    "attempts": t.get("attempts"),
+                    "status": t.get("status"),
+                }
+                for tid, t in sorted(trials.items())
+                if t.get("resumed")
+            },
+        })
+    else:
+        states: Dict[str, str] = {}
+        for tid, ev in sorted(digest["trial_latest"].items()):
+            states[tid] = ev.get("phase")
+        out["trial_states"] = states
+        best = digest["best_done"]
+        out["best_so_far"] = (
+            {
+                "trial": best.get("trial"),
+                "family": best.get("family"),
+                "lr": best.get("lr"),
+                "best_top1": best.get("best_top1"),
+            }
+            if best
+            else None
+        )
+    return out
+
+
 def _resilience(manifest, events) -> Dict[str, Any]:
     """Checkpoint/restart posture: how much work a preemption would
     cost right now, and how this run relates to its ancestors."""
@@ -462,6 +521,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     resilience = _resilience(manifest, events)
     health = _health(events)
     serving = _serving(events)
+    search = _search(events)
     # the LAST static-analysis verdict recorded on this timeline
     # (`check --events-into RUN_DIR`, bdbnn_tpu/analysis/)
     analysis_ev = next(
@@ -504,6 +564,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
         "resilience": resilience,
         "health": health,
         "serving": serving,
+        "search": search,
         "analysis": analysis,
         "nonfinite_intervals": len(nonfinite),
     }
@@ -570,6 +631,72 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
             f"{analysis.get('files_scanned')} files; "
             + ", ".join(analysis.get("checkers") or []) + ")"
         )
+    if search:
+        lines.append(
+            f"recipe search: {search.get('trials_total')} trial(s)"
+            + (
+                f" over families {', '.join(search.get('families') or [])}"
+                if search.get("families")
+                else ""
+            )
+            + (
+                f" | {search.get('workers')} worker(s)"
+                if search.get("workers")
+                else ""
+            )
+            + (" | PREEMPTED mid-sweep" if search.get("preempted") else "")
+        )
+        ranking = search.get("ranking")
+        if ranking is not None:
+            lines.append(
+                f"  leaderboard ({search.get('completed')} completed, "
+                f"{search.get('failed')} failed; common acc level "
+                f"{search.get('common_acc_level')}):"
+            )
+            lines.append(
+                f"  {'rank':<5} {'trial':<26} {'family':<22} "
+                f"{'lr':>8} {'best':>7} {'final':>7} {'t->common':>10}"
+            )
+            trials_meta = search.get("trials") or {}
+            for row in ranking:
+                meta = trials_meta.get(row.get("trial")) or {}
+                ttca = meta.get("time_to_common_acc_s")
+                lines.append(
+                    f"  {row.get('rank'):<5} {row.get('trial'):<26} "
+                    f"{row.get('family'):<22} {row.get('lr'):>8g} "
+                    f"{row.get('best_top1'):>7} {row.get('final_top1'):>7} "
+                    f"{(str(ttca) + 's') if ttca is not None else '-':>10}"
+                )
+            winner = search.get("winner")
+            if winner:
+                lines.append(
+                    f"  winner: {winner.get('trial')} "
+                    f"({winner.get('family')} @ lr {winner.get('lr')}) "
+                    f"best {winner.get('best_top1')} -> "
+                    f"{winner.get('run_dir')}"
+                )
+            resumed = search.get("resumed_trials") or {}
+            for tid, info in resumed.items():
+                lines.append(
+                    f"  resumed lineage: {tid} took "
+                    f"{info.get('attempts')} attempt(s) "
+                    f"({info.get('status')}) — completed trials were "
+                    "never re-run"
+                )
+        else:
+            states = search.get("trial_states") or {}
+            if states:
+                lines.append(
+                    "  live trial states: "
+                    + ", ".join(f"{t}={s}" for t, s in states.items())
+                )
+            best = search.get("best_so_far")
+            if best:
+                lines.append(
+                    f"  best so far: {best.get('trial')} "
+                    f"({best.get('family')} @ lr {best.get('lr')}) "
+                    f"best_top1 {best.get('best_top1')}"
+                )
     if serving:
         for ex in serving["exports"]:
             lines.append(

@@ -469,6 +469,74 @@ def _serve_lines(events) -> List[str]:
     return lines
 
 
+def _search_lines(events) -> List[str]:
+    """The recipe-search view: when a timeline carries ``search``/
+    ``trial`` events (a sweep dir, bdbnn_tpu/search/) render the live
+    trial states and the current best; at the verdict, the final
+    leaderboard summary."""
+    from bdbnn_tpu.search.harness import search_digest
+
+    digest = search_digest(events)
+    start = digest["start"]
+    if start is None and digest["verdict"] is None:
+        return []
+    lines: List[str] = []
+    if start:
+        lines.append(
+            f"search: {start.get('trials_total')} trial(s) over "
+            f"{len(start.get('families') or [])} famil"
+            f"{'y' if len(start.get('families') or []) == 1 else 'ies'}"
+            f" | {start.get('workers')} worker(s)"
+            + (
+                " | resumed sweep"
+                if start.get("phase") == "resume"
+                else ""
+            )
+        )
+    verdict = digest["verdict"]
+    if verdict is not None:
+        winner = verdict.get("winner") or {}
+        lines.append(
+            f"  VERDICT: {verdict.get('completed')}/"
+            f"{verdict.get('trials_total')} completed, "
+            f"{verdict.get('failed')} failed | winner "
+            f"{winner.get('trial')} ({winner.get('family')} @ lr "
+            f"{winner.get('lr')}) best {winner.get('best_top1')}"
+        )
+        return lines
+    # live: latest phase per trial + the running best
+    for tid in sorted(digest["trial_latest"]):
+        ev = digest["trial_latest"][tid]
+        phase = ev.get("phase")
+        mark = {
+            "done": "done",
+            "failed": "FAILED",
+            "preempted": "preempted",
+            "interrupted": "interrupted",
+        }.get(phase, "running")
+        extra = (
+            f" best {ev.get('best_top1')}" if phase == "done" else ""
+        )
+        lines.append(
+            f"  {tid}: {mark} ({ev.get('family')} @ lr "
+            f"{ev.get('lr')}){extra}"
+        )
+    best = digest["best_done"]
+    if best:
+        lines.append(
+            f"  best so far: {best.get('trial')} best_top1 "
+            f"{best.get('best_top1')}"
+        )
+    if digest["preempted"]:
+        lines.append(
+            f"  !! sweep preempted (signal "
+            f"{digest['preempted'].get('signum')}) — "
+            f"{digest['preempted'].get('completed')} trial(s) done; "
+            "resume with `search --resume`"
+        )
+    return lines
+
+
 def render_status(
     events: List[Dict[str, Any]],
     manifest: Optional[Dict[str, Any]] = None,
@@ -491,6 +559,7 @@ def render_status(
     restarts = len((manifest or {}).get("restart_lineage") or [])
 
     lines = []
+    lines += _search_lines(events)
     lines += _serve_lines(events)
     if start:
         lines.append(
@@ -623,11 +692,16 @@ def watch_run(
             last_size = size
             events = read_events(run_dir)
             out(render_status(events, manifest))
-            # a serve-bench run ends at its verdict, a training run at
-            # run_end — either terminates the tail
+            # a serve-bench run ends at its verdict, a search sweep at
+            # its leaderboard verdict, a training run at run_end — any
+            # of them terminates the tail
             if once or any(
                 e.get("kind") == "run_end"
                 or (e.get("kind") == "serve" and e.get("phase") == "verdict")
+                or (
+                    e.get("kind") == "search"
+                    and e.get("phase") == "verdict"
+                )
                 for e in events
             ):
                 return 0

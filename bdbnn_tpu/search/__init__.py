@@ -1,0 +1,29 @@
+"""Recipe search: preemption-resilient sweeps over binarizer families.
+
+The science-side counterpart of the serving autopilot: a trial spec
+(binarizer family x schedule params x learning rate) fans out short
+budgeted ``fit()`` runs as real CLI subprocesses, a SIGTERM mid-sweep
+checkpoints the in-flight trials through the PR 3 resilience layer
+(exit 75), ``search --resume`` continues the sweep without re-running
+completed trials (integrity-digested trial ledger), and the finished
+sweep is ranked into a deterministic strict-JSON leaderboard with
+``obs/compare.py``'s time-to-common-accuracy judgment.
+"""
+
+from bdbnn_tpu.search.harness import (
+    LEADERBOARD_NAME,
+    LEDGER_NAME,
+    TrialLedger,
+    build_leaderboard,
+    run_search,
+    search_digest,
+)
+
+__all__ = [
+    "LEADERBOARD_NAME",
+    "LEDGER_NAME",
+    "TrialLedger",
+    "build_leaderboard",
+    "run_search",
+    "search_digest",
+]

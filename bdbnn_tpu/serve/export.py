@@ -158,15 +158,25 @@ def export_artifact(
     packed_bytes = 0
     binarized = 0
 
+    # the per-channel alpha is the FAMILY's scale (nn/binarize.py
+    # registry — the run's manifest records which family trained these
+    # weights): mean|W| for the default lineage, the loss-aware
+    # ΣW²/Σ|W| for `lab`. The serving fixed point is family-invariant
+    # (mean|sign·alpha| == alpha for any positive per-channel alpha),
+    # but the STORED alpha must be the training one or the artifact
+    # would not reproduce the checkpoint's eval logits.
+    from bdbnn_tpu.nn.binarize import resolve_family, weight_alpha_np
+
+    family_name = resolve_family(
+        config.get("binarizer", ""), ede=bool(config.get("ede"))
+    ).name
+
     for path, leaf in _flat_leaves(variables["params"]):
         name = "/".join(path)
         leaf = np.asarray(leaf)
         if path[-1] == "float_weight" and leaf.ndim == 4:
             # binarize ONCE: packed sign + per-out-channel alpha
-            alpha = np.mean(
-                np.abs(leaf.astype(np.float32)),
-                axis=tuple(range(leaf.ndim - 1)),
-            ).astype(np.float32)
+            alpha = weight_alpha_np(family_name, leaf)
             packed = _pack_sign(leaf)
             base = "/".join(path[:-1])
             arrays[f"sign:{base}"] = packed

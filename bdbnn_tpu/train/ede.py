@@ -11,6 +11,15 @@ onto every ``nn.Conv2d`` as module attributes each epoch
 (``train.py:409-415``), forcing autograd to read module state; here
 they are plain scalars passed as *traced arguments* into the jitted
 step, so the annealing never retraces or recompiles.
+
+This traced-scalar discipline is generalized by the binarizer-family
+registry (:mod:`bdbnn_tpu.nn.binarize`): every family may carry a
+per-epoch schedule tuple (``ede`` → this module's (t, k); ``proximal``
+→ an annealed δ), produced host-side by
+:meth:`BinarizerFamily.schedule` and fed into the step exactly like
+(t, k) always was. ``cpt_tk`` stays the canonical EDE math — the
+registry's ``ede`` entry calls it, keeping reference parity pinned in
+one place.
 """
 
 from __future__ import annotations

@@ -57,6 +57,7 @@ from bdbnn_tpu.models import (
     module_path_str,
 )
 from bdbnn_tpu.models.torch_import import load_torch_checkpoint
+from bdbnn_tpu.nn.binarize import resolve_family, set_active_family
 from bdbnn_tpu.obs import (
     EventWriter,
     ObsHooks,
@@ -630,6 +631,11 @@ def fit(cfg: RunConfig) -> Dict[str, float]:
 
 def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
     cfg = cfg.validate()
+    # the binarizer family (nn/binarize.py registry) is a trace-time
+    # constant: install it BEFORE any model/step is built. validate()
+    # already canonicalized cfg.binarizer (--ede -> "ede", default ->
+    # "ste"), so the manifest records exactly what is installed here.
+    family = set_active_family(resolve_family(cfg.binarizer, ede=cfg.ede))
     if cfg.distributed_init:
         jax.distributed.initialize()
 
@@ -806,6 +812,10 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         temperature=cfg.temperature,
         w_lambda_ce=cfg.w_lambda_ce,
         ede=cfg.ede,
+        binarizer=family.name,
+        binarizer_schedule=family.schedule_len > 0,
+        binarizer_stochastic=family.stochastic,
+        rng_seed=cfg.seed or 0,
         input_norm=input_norm,
         # fit() runs want the starvation probe; bench/profile build
         # their own StepConfig and measure the unperturbed step
@@ -882,6 +892,14 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
         t, k = cpt_tk(epoch, cfg.epochs) if cfg.ede else (1.0, 1.0)
         gate = 1.0 if epoch >= cfg.kurtepoch else 0.0
         return float(t), float(k), float(gate)
+
+    def _sched_values(epoch):
+        """The active family's schedule tuple entering ``epoch`` — the
+        generalized form of (t, k): () for schedule-free families,
+        cpt_tk for ede (bitwise the legacy pair), (δ,) for proximal.
+        Recorded next to ede_t/ede_k in checkpoint/restore events so
+        ANY family's resume point is auditable bitwise."""
+        return [float(v) for v in family.schedule(epoch, cfg.epochs)]
 
     best_acc1, best_epoch = 0.0, -1
     start_epoch = cfg.start_epoch
@@ -1040,6 +1058,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 ede_t=ede_t,
                 ede_k=ede_k,
                 kurt_gate=kurt_gate,
+                binarizer=cfg.binarizer,
+                sched=_sched_values(start_epoch),
                 topology_from=topo_from,
                 topology_to=topo_to,
                 resharded=resharded,
@@ -1124,6 +1144,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 "ede_t": ede_t,
                 "ede_k": ede_k,
                 "kurt_gate": kg,
+                "binarizer": cfg.binarizer,
+                "sched": _sched_values(epoch),
                 "topology": topology(mesh),
             },
         )
@@ -1259,6 +1281,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 "ede_t": ede_t,
                 "ede_k": ede_k,
                 "kurt_gate": kurt_gate,
+                "binarizer": cfg.binarizer,
+                "sched": _sched_values(target_epoch),
                 # writer topology: what an elastic resume compares its
                 # own layout against (restore event reshard lineage)
                 "topology": topology(mesh),
@@ -1273,6 +1297,8 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
             ede_t=ede_t,
             ede_k=ede_k,
             kurt_gate=kurt_gate,
+            binarizer=cfg.binarizer,
+            sched=_sched_values(target_epoch),
             # True when this save ran as an aligned collective decided
             # by the step-boundary coordination all-reduce
             coordinated=jax.process_count() > 1,
@@ -1304,7 +1330,21 @@ def _fit(cfg: RunConfig, _resources: list) -> Dict[str, float]:
                 # starvation when an EDE run stalls (VERDICT r4 weak #5)
                 writer.add_scalar("EDE t", float(t), epoch)
                 writer.add_scalar("EDE k", float(k), epoch)
-            tk = (jnp.float32(t), jnp.float32(k))
+            # the family's schedule tuple enters the jitted step as
+            # traced scalars (the EDE discipline, generalized): ede's
+            # (t, k) bitwise as before, proximal's (δ,), () families
+            # keep the legacy placeholder pair the step never reads
+            sched_vals = family.schedule(epoch, cfg.epochs)
+            if sched_vals and family.name != "ede":
+                for i, v in enumerate(sched_vals):
+                    writer.add_scalar(
+                        f"Binarizer {family.name} s{i}", float(v), epoch
+                    )
+            tk = (
+                tuple(jnp.float32(v) for v in sched_vals)
+                if sched_vals
+                else (jnp.float32(t), jnp.float32(k))
+            )
             kurt_gate = jnp.float32(1.0 if epoch >= cfg.kurtepoch else 0.0)
 
             state = _train_epoch(

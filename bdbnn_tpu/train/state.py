@@ -76,8 +76,20 @@ class StepConfig:
     temperature: float = 4.0
     w_lambda_ce: float = 1.0
     kd_pairs: Tuple[Tuple[Tuple[str, ...], Tuple[str, ...]], ...] = ()
-    # EDE
+    # EDE (legacy flag: True ⇔ the 'ede' binarizer family — kept so
+    # direct StepConfig builders (bench.py, tests) stay source-stable)
     ede: bool = False
+    # binarizer family (nn/binarize.py registry): the resolved family
+    # NAME plus the two facts the jitted step needs at trace time —
+    # whether the family carries a per-epoch schedule (its traced
+    # scalars are then passed into model.apply as `tk`) and whether it
+    # samples (a per-step jax.random key is then threaded through the
+    # 'binarize' rng stream, derived from (rng_seed, state.step) so a
+    # resumed step replays the same masks bitwise)
+    binarizer: str = "ste"
+    binarizer_schedule: bool = False
+    binarizer_stochastic: bool = False
+    rng_seed: int = 0
     # observability: emit optax.global_norm(grads) as metrics
     # ['grad_norm'] — the estimator-starvation probe (VERDICT r4 weak
     # #5). Default OFF so bench/profile workloads that build StepConfig

@@ -11,10 +11,16 @@ the compiled program.
 
 Per-epoch variation enters as traced scalars:
 
-- ``tk``         — EDE (t, k) (↔ module mutation ``train.py:409-415``),
+- ``tk``         — the binarizer family's schedule tuple: EDE (t, k)
+  (↔ module mutation ``train.py:409-415``), proximal (δ,) — whatever
+  the active family (nn/binarize.py registry) anneals,
 - ``kurt_gate``  — 1.0 when ``epoch >= kurtepoch`` (↔ ``train.py:497``),
 
-so no retrace ever happens across epochs.
+so no retrace ever happens across epochs. The stochastic family's
+sampling key is likewise derived INSIDE the step from
+``(rng_seed, state.step)`` (``jax.random.fold_in``) — pure in the
+traced inputs, so a preempted run resumed at the same step replays the
+same binarization masks bitwise.
 """
 
 from __future__ import annotations
@@ -61,6 +67,24 @@ def topk_correct(
         kk = min(k, logits.shape[-1])
         out[f"top{k}"] = jnp.sum(hit[:, :kk])
     return out
+
+
+def _apply_kwargs(cfg: StepConfig, state: TrainState, tk) -> Dict[str, Any]:
+    """The per-family extras of a train-mode ``model.apply``: the
+    traced schedule tuple (``tk``) when the family anneals one, and
+    the ``binarize`` rng stream when it samples. Schedule-free,
+    deterministic families contribute nothing — the default path is
+    bitwise the pre-registry apply."""
+    kwargs: Dict[str, Any] = {}
+    if cfg.ede or cfg.binarizer_schedule:
+        kwargs["tk"] = tk
+    if cfg.binarizer_stochastic:
+        kwargs["rngs"] = {
+            "binarize": jax.random.fold_in(
+                jax.random.PRNGKey(cfg.rng_seed), state.step
+            )
+        }
+    return kwargs
 
 
 def _prep_images(images: Array, input_norm) -> Array:
@@ -156,7 +180,7 @@ def make_train_step(
         images = _prep_images(images, cfg.input_norm)
 
         def loss_fn(params):
-            kwargs = {"tk": tk} if cfg.ede else {}
+            kwargs = _apply_kwargs(cfg, state, tk)
             logits, mutated = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
                 images,
@@ -214,7 +238,7 @@ def make_ts_train_step(
         images = _prep_images(images, cfg.input_norm)
 
         def loss_fn(params):
-            kwargs = {"tk": tk} if cfg.ede else {}
+            kwargs = _apply_kwargs(cfg, state, tk)
             logits, mutated = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
                 images,

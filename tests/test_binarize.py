@@ -1,19 +1,32 @@
-"""Gradient-correctness tests for the binarization custom_vjps.
+"""Gradient-correctness tests for the binarization custom_vjps and the
+binarizer-family registry.
 
 Mirrors the test strategy SURVEY.md §4 prescribes: STE/EDE gradients vs
-the closed-form clipped-identity / polynomial / annealed-tanh estimators.
+the closed-form clipped-identity / polynomial / annealed-tanh
+estimators, extended per family — proximal tent backward, stochastic
+forward expectation, loss-aware alpha — plus the registry pins: the
+default family routes through EXACTLY the legacy functions (bitwise),
+schedules never retrace, specs validate at parse time.
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bdbnn_tpu.nn.binarize import (
+    active_family,
     approx_sign,
     binarize_act,
     binarize_weight,
     ede_sign,
+    get_active_family,
+    make_family,
+    parse_binarizer,
+    prox_sign,
+    resolve_family,
     ste_sign,
+    stoch_sign,
 )
 
 X = jnp.array([-2.5, -1.0, -0.5, -0.0, 0.0, 0.3, 1.0, 1.7])
@@ -102,3 +115,260 @@ def test_binarization_under_jit_and_vmap():
     f = jax.jit(jax.vmap(lambda x: ste_sign(x) * 2.0))
     x = jnp.ones((4, 8)) * 0.5
     np.testing.assert_allclose(np.asarray(f(x)), 2.0 * np.ones((4, 8)))
+
+
+# ---------------------------------------------------------------------------
+# Proximal family (arXiv:2402.17710)
+# ---------------------------------------------------------------------------
+
+
+class TestProxSign:
+    def test_forward_is_pm1_with_sign0_plus1(self):
+        y = prox_sign(X, jnp.float32(0.7))
+        np.testing.assert_array_equal(
+            np.asarray(y),
+            np.array([-1, -1, -1, 1, 1, 1, 1, 1], np.float32),
+        )
+
+    def test_backward_is_unit_mass_tent(self):
+        """dL/dx = (2/δ)·max(0, 1 − |x|/δ): closed form at several δ,
+        and the mass ∫ dx == 2 for every δ (what the clipped-identity
+        STE passes over [-1, 1]) — sharpening concentrates, never
+        attenuates."""
+        for delta in (0.25, 1.0, 2.0):
+            g = jax.grad(
+                lambda x: prox_sign(x, jnp.float32(delta)).sum()
+            )(X)
+            xa = np.abs(np.asarray(X))
+            expect = (2.0 / delta) * np.clip(1.0 - xa / delta, 0.0, None)
+            np.testing.assert_allclose(
+                np.asarray(g), expect.astype(np.float32), rtol=1e-5
+            )
+        # tent mass: base 2δ x height 2/δ / 2 == 2, δ-independent
+        xs = np.linspace(-4, 4, 20001, dtype=np.float64)
+        dx = xs[1] - xs[0]
+        for delta in (0.25, 1.0, 2.0):
+            tent = (2.0 / delta) * np.clip(1.0 - np.abs(xs) / delta, 0, None)
+            assert float(tent.sum() * dx) == pytest.approx(2.0, rel=1e-3)
+
+    def test_delta_one_equals_bireal_polynomial(self):
+        g1 = jax.grad(lambda x: prox_sign(x, jnp.float32(1.0)).sum())(X)
+        g2 = jax.grad(lambda x: approx_sign(x).sum())(X)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-6)
+
+    def test_delta_change_does_not_retrace(self):
+        """The schedule no-retrace pin, proximal edition: annealing δ
+        across epochs must reuse the one compiled step (the EDE (t, k)
+        discipline)."""
+        traces = []
+
+        @jax.jit
+        def f(x, delta):
+            traces.append(1)
+            return prox_sign(x, delta).sum()
+
+        f(X, jnp.float32(2.0))
+        f(X, jnp.float32(0.5))
+        assert len(traces) == 1
+
+    def test_schedule_anneals_log_linearly(self):
+        fam = make_family("proximal", {"delta0": 2.0, "delta1": 0.5})
+        (d0,) = fam.schedule(0, 4)
+        (d4,) = fam.schedule(4, 4)
+        assert d0 == pytest.approx(2.0)
+        assert d4 == pytest.approx(0.5)
+        (dmid,) = fam.schedule(2, 4)
+        assert dmid == pytest.approx((2.0 * 0.5) ** 0.5)  # log-linear
+
+
+# ---------------------------------------------------------------------------
+# Stochastic family (BinaryNet, arXiv:1602.02830)
+# ---------------------------------------------------------------------------
+
+
+class TestStochSign:
+    def test_deterministic_outside_unit_interval(self):
+        """P(+1) = hard-sigmoid: saturated at |x| >= 1, so the sample
+        equals the hard sign there for EVERY draw."""
+        x = jnp.array([-3.0, -1.0, 1.0, 2.5])
+        for i in range(16):
+            u = jax.random.uniform(jax.random.PRNGKey(i), x.shape)
+            np.testing.assert_array_equal(
+                np.asarray(stoch_sign(x, u)),
+                np.array([-1, -1, 1, 1], np.float32),
+            )
+
+    def test_fixed_key_is_deterministic(self):
+        u = jax.random.uniform(jax.random.PRNGKey(7), X.shape)
+        a = np.asarray(stoch_sign(X, u))
+        b = np.asarray(stoch_sign(X, u))
+        np.testing.assert_array_equal(a, b)
+        assert set(np.unique(a)) <= {-1.0, 1.0}
+
+    def test_expectation_approx_hard_sign_envelope(self):
+        """E[stoch_sign(x)] = 2·σ̂(x) − 1 = clip(x, −1, 1) — equal to
+        the hard sign wherever it saturates, the linear envelope
+        between."""
+        n = 4000
+        acc = np.zeros(X.shape, np.float64)
+        for i in range(n):
+            u = jax.random.uniform(jax.random.PRNGKey(i), X.shape)
+            acc += np.asarray(stoch_sign(X, u))
+        mean = acc / n
+        np.testing.assert_allclose(
+            mean, np.clip(np.asarray(X), -1.0, 1.0), atol=0.05
+        )
+
+    def test_backward_is_clipped_identity(self):
+        u = jax.random.uniform(jax.random.PRNGKey(3), X.shape)
+        g = jax.grad(lambda x: stoch_sign(x, u).sum())(X)
+        expect = (np.abs(np.asarray(X)) <= 1.0).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(g), expect)
+
+    def test_no_rng_falls_back_to_hard_sign(self):
+        """Eval/serving convention: without a key the family is the
+        deterministic sign (sign(0) := +1 included)."""
+        fam = make_family("stochastic")
+        np.testing.assert_array_equal(
+            np.asarray(fam.binarize_act(X, rng=None)),
+            np.asarray(ste_sign(X)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Loss-aware family (arXiv:1611.01600)
+# ---------------------------------------------------------------------------
+
+
+class TestLabFamily:
+    def test_alpha_is_curvature_weighted(self):
+        """alpha = ||d∘W||₁/||d||₁ with d = |W| -> ΣW²/Σ|W| per output
+        channel — upweights large-magnitude weights vs plain mean|W|."""
+        w = jnp.array([[1.0, -2.0], [3.0, -4.0], [-0.5, 0.5]])
+        fam = make_family("lab")
+        a = np.asarray(fam.weight_alpha(w))
+        wn = np.asarray(w)
+        expect = np.mean(wn * wn, 0) / (np.mean(np.abs(wn), 0) + 1e-12)
+        np.testing.assert_allclose(a, expect, rtol=1e-6)
+        # strictly >= mean|W| (Cauchy-Schwarz; equality iff uniform |W|)
+        assert (a >= np.mean(np.abs(wn), 0) - 1e-6).all()
+
+    def test_weight_grads_keep_ste(self):
+        fam = make_family("lab")
+        w = jnp.array([[0.5, -2.0], [0.3, -0.1]])
+
+        def f(w):
+            return (fam.weight_sign(w)
+                    * jax.lax.stop_gradient(fam.weight_alpha(w))).sum()
+
+        g = jax.grad(f)(w)
+        wn = np.asarray(w)
+        alpha = np.mean(wn * wn, 0) / (np.mean(np.abs(wn), 0) + 1e-12)
+        expect = alpha[None, :] * (np.abs(wn) <= 1.0)
+        np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Registry: parsing, resolution, legacy-bitwise dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestFamilyRegistry:
+    def test_parse_and_canonical_spec(self):
+        name, params = parse_binarizer("proximal:delta0=1.5")
+        assert name == "proximal"
+        assert params == {"delta0": 1.5, "delta1": 0.5}
+        fam = make_family(name, params)
+        assert fam.spec == "proximal:delta0=1.5"
+        assert make_family("ste").spec == "ste"
+
+    def test_unknown_family_and_param_rejected(self):
+        with pytest.raises(ValueError, match="unknown binarizer family"):
+            parse_binarizer("xnorpp")
+        with pytest.raises(ValueError, match="no param"):
+            parse_binarizer("proximal:gamma=2")
+        with pytest.raises(ValueError, match="not a number"):
+            parse_binarizer("proximal:delta0=fast")
+        with pytest.raises(ValueError, match="> 0"):
+            parse_binarizer("proximal:delta0=-1")
+        with pytest.raises(ValueError, match="PARAM=VALUE"):
+            parse_binarizer("proximal:delta0")
+
+    def test_legacy_resolution_and_conflict(self):
+        assert resolve_family("", ede=False).name == "ste"
+        assert resolve_family("", ede=True).name == "ede"
+        assert resolve_family("ede", ede=True).name == "ede"
+        with pytest.raises(ValueError, match="drop --ede"):
+            resolve_family("proximal", ede=True)
+
+    def test_default_families_dispatch_bitwise_to_legacy_fns(self):
+        """The refactor contract: the registry entries for the three
+        pre-existing estimators ARE the legacy functions — forward and
+        backward bitwise, including the (t, k)-pair legacy dispatch of
+        the default family."""
+        tk = (jnp.float32(0.5), jnp.float32(2.0))
+        cases = [
+            (make_family("ste"), None, ste_sign(X)),
+            (make_family("approx"), None, approx_sign(X)),
+            (make_family("ede"), tk, ede_sign(X, *tk)),
+            (make_family("ste"), tk, ede_sign(X, *tk)),  # legacy tk path
+        ]
+        for fam, sched, expect in cases:
+            got = fam.binarize_act(X, sched=sched)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(expect))
+        # backward too
+        g_fam = jax.grad(
+            lambda x: make_family("ede").binarize_act(x, sched=tk).sum()
+        )(X)
+        g_leg = jax.grad(lambda x: ede_sign(x, *tk).sum())(X)
+        np.testing.assert_array_equal(np.asarray(g_fam),
+                                      np.asarray(g_leg))
+
+    def test_default_weight_path_bitwise_legacy(self):
+        """weight_sign + weight_alpha of the default family reproduce
+        the pre-registry inline code (ste_sign + detached mean|W|)
+        bitwise, forward and gradient."""
+        w = jax.random.normal(jax.random.PRNGKey(0), (3, 3, 4, 8))
+        fam = make_family("ste")
+
+        def new_path(w):
+            return (
+                fam.weight_sign(w)
+                * jax.lax.stop_gradient(fam.weight_alpha(w))
+            ).sum()
+
+        def legacy_path(w):
+            signed = ste_sign(w)
+            alpha = jax.lax.stop_gradient(
+                jnp.mean(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+            )
+            return (signed * alpha).sum()
+
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(new_path)(w)),
+            np.asarray(jax.jit(legacy_path)(w)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(jax.grad(new_path))(w)),
+            np.asarray(jax.jit(jax.grad(legacy_path))(w)),
+        )
+
+    def test_schedule_families_fall_back_to_ste_on_eval(self):
+        """No sched (the eval path) -> plain STE sign for every
+        deterministic family: the eval forward is family-invariant
+        modulo the weight alpha."""
+        for name in ("ede", "proximal"):
+            fam = make_family(name)
+            np.testing.assert_array_equal(
+                np.asarray(fam.binarize_act(X, sched=None)),
+                np.asarray(ste_sign(X)),
+            )
+
+    def test_active_family_context_restores(self):
+        before = get_active_family().name
+        with active_family("proximal") as fam:
+            assert fam.name == "proximal"
+            assert get_active_family().name == "proximal"
+        assert get_active_family().name == before

@@ -180,6 +180,95 @@ class TestTpuNativeFlags:
             parse(["/data", "--events-max-mb", "-1"]).validate()
 
 
+class TestBinarizerFlag:
+    def test_binarizer_flag_parses_and_canonicalizes(self):
+        cfg = parse(["/data", "--binarizer", "proximal:delta1=0.25"])
+        assert cfg.binarizer == "proximal:delta1=0.25"
+        cfg = cfg.validate()
+        assert cfg.binarizer == "proximal:delta1=0.25"
+        # legacy mapping canonicalized by validate(): default -> ste,
+        # --ede -> ede, and the ede flag follows the family
+        assert parse(["/data"]).validate().binarizer == "ste"
+        ede_cfg = parse(["/data", "--ede"]).validate()
+        assert ede_cfg.binarizer == "ede" and ede_cfg.ede
+        fam_cfg = parse(["/data", "--binarizer", "ede"]).validate()
+        assert fam_cfg.binarizer == "ede" and fam_cfg.ede
+
+    def test_bad_binarizer_rejected_at_config_time(self):
+        with pytest.raises(ValueError, match="unknown binarizer"):
+            parse(["/data", "--binarizer", "xnorpp"]).validate()
+        with pytest.raises(ValueError, match="no param"):
+            parse(["/data", "--binarizer", "ste:gamma=1"]).validate()
+        with pytest.raises(ValueError, match="drop --ede"):
+            parse(["/data", "--ede", "--binarizer", "lab"]).validate()
+
+
+class TestSearchCliSmoke:
+    """The `search` console entrypoint as a real subprocess: one tiny
+    single-trial sweep, then summarize + watch --once consume the
+    sweep dir (the multi-trial and preemption e2es live in
+    tests/test_search.py)."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _run(self, *argv, timeout=300):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = self.REPO + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else ""
+        )
+        return subprocess.run(
+            [sys.executable, "-m", "bdbnn_tpu.cli", *argv],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=self.REPO,
+        )
+
+    @pytest.fixture(scope="class")
+    def tiny_sweep(self, tmp_path_factory):
+        out_dir = str(tmp_path_factory.mktemp("cli_sweep") / "sweep")
+        proc = self._run(
+            "search", "--out-dir", out_dir,
+            "--trial", "ste@0.05",
+            "-a", "resnet8_tiny", "--epochs", "1", "-b", "16",
+            "-p", "2", "--synthetic", "--synthetic-train-size", "64",
+            "--synthetic-val-size", "64", "--seed", "0",
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        return out_dir, proc
+
+    def test_search_prints_leaderboard(self, tiny_sweep):
+        out_dir, proc = tiny_sweep
+        lb = json.loads(proc.stdout)
+        assert lb["search_verdict"] == 1
+        assert lb["completed"] == 1
+        assert lb["winner"]["family"] == "ste"
+        assert "[search] sweep dir:" in proc.stderr
+        assert os.path.exists(os.path.join(out_dir, "leaderboard.json"))
+        assert os.path.exists(os.path.join(out_dir, "ledger.json"))
+
+    def test_summarize_renders_sweep(self, tiny_sweep):
+        out_dir, _ = tiny_sweep
+        proc = self._run("summarize", out_dir)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "recipe search: 1 trial(s)" in proc.stdout
+        assert "winner: t000_ste_lr0.05" in proc.stdout
+
+    def test_watch_once_renders_sweep(self, tiny_sweep):
+        out_dir, _ = tiny_sweep
+        proc = self._run("watch", out_dir, "--once")
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "search: 1 trial(s)" in proc.stdout
+        assert "VERDICT: 1/1 completed" in proc.stdout
+
+    def test_bad_family_fails_at_the_command_line(self, tmp_path):
+        proc = self._run(
+            "search", "--out-dir", str(tmp_path / "s"),
+            "--families", "bogus", "--synthetic", timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "unknown binarizer family" in (proc.stderr + proc.stdout)
+
+
 class TestSummarizeSubcommand:
     """The console entrypoint for post-hoc reports must not silently
     break: run ``python -m bdbnn_tpu.cli summarize`` as a real

@@ -133,6 +133,41 @@ class TestAlignment:
         assert forced["verdict"] == "regression"  # judged anyway
         assert forced["comparisons"][0]["mismatches"]
 
+    def test_binarizer_family_is_a_recipe_field(self, repo_cwd, tmp_path):
+        """Runs trained under different binarizer families must never
+        silently compare as same-recipe (the registry's alignment
+        contract); pre-registry manifests (no key -> None) still
+        align."""
+        import shutil
+
+        clone = tmp_path / "cand_fam"
+        shutil.copytree(os.path.join(REPO, CAND), clone)
+        man_path = clone / "manifest.json"
+        man = json.loads(man_path.read_text())
+        man["config"]["binarizer"] = "proximal:delta1=0.25"
+        man_path.write_text(json.dumps(man))
+
+        base2 = tmp_path / "base_fam"
+        shutil.copytree(os.path.join(REPO, BASE), base2)
+        bman_path = base2 / "manifest.json"
+        bman = json.loads(bman_path.read_text())
+        bman["config"]["binarizer"] = "ste"
+        bman_path.write_text(json.dumps(bman))
+
+        result = compare_runs([str(base2), str(clone)])
+        assert result["verdict"] == "incomparable"
+        assert any(
+            "binarizer" in m
+            for m in result["comparisons"][0]["mismatches"]
+        )
+        # one side unknown (the checked-in pre-registry fixture) ->
+        # never a mismatch
+        legacy = compare_runs([os.path.join(REPO, BASE), str(clone)])
+        assert not any(
+            "binarizer" in m
+            for m in legacy["comparisons"][0]["mismatches"]
+        )
+
     def test_unknown_fields_do_not_mismatch(self, repo_cwd, tmp_path):
         """Artifacts carry partial provenance: a field one side doesn't
         know is not a mismatch."""

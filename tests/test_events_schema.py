@@ -64,14 +64,17 @@ class TestEmitCallSites:
         the serving/front-end/replica-pool kinds, the request-tracing
         and canary kinds, the fleet router's ``fleet`` kind
         (serve/fleet.py), and the static analyzer's own ``analysis``
-        kind (the `check --events-into` emit in cli.py)."""
+        kind (the `check --events-into` emit in cli.py), and the
+        recipe-search harness's ``search``/``trial`` kinds
+        (bdbnn_tpu/search/harness.py)."""
         _findings, found = scan_events(REPO, SCANNED)
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
                 "checkpoint", "restore", "preempt", "data_error",
                 "alert", "health", "export", "serve",
                 "http", "admission", "replica", "swap", "fleet",
-                "rtrace", "canary", "shadow", "analysis"} <= found
+                "rtrace", "canary", "shadow", "search", "trial",
+                "analysis"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync
@@ -674,6 +677,85 @@ class TestStrictRfc8259:
         assert rec["records"][0].endswith("'with self._lock'")
         # the emit() return value matches what was written
         assert a["findings"] == 2 and a["suppressed"] == 1
+
+    def test_search_trial_kind_payloads_roundtrip(self, tmp_path):
+        """The recipe-search payload shapes (bdbnn_tpu/search/
+        harness.py) with adversarial values in the numeric slots: a
+        NaN best_top1 must land as null, numpy counters must unwrap,
+        and the nested leaderboard structures (ranking rows, winner
+        block, per-trial table) must survive strict parsing."""
+        ev = EventWriter(str(tmp_path))
+        ev.emit(
+            "search",
+            phase="start",
+            trials_total=np.int64(3),
+            completed=0,
+            families=["ste", "proximal:delta1=0.25", "stochastic"],
+            workers=np.int64(2),
+            config_hash="abc123",
+        )
+        ev.emit(
+            "trial",
+            phase="done",
+            trial="t000_ste_lr0.1",
+            family="ste",
+            lr=np.float64(0.1),
+            best_top1=float("nan"),
+            final_top1=np.float32(12.5),
+            wall_s=np.float64(3.0),
+            run_dir="/tmp/sweep/trials/t000",
+        )
+        ev.emit(
+            "trial",
+            phase="failed",
+            trial="t001_ede_lr0.1",
+            family="ede",
+            lr=0.1,
+            rc=np.int64(-9),
+            run_dir=None,
+        )
+        ev.emit(
+            "search",
+            phase="verdict",
+            search_verdict=1,
+            trials_total=3,
+            completed=np.int64(2),
+            failed=1,
+            common_acc_level=np.float32(12.5),
+            ranking=[
+                {"rank": 1, "trial": "t000", "family": "ste",
+                 "lr": np.float64(0.1),
+                 "best_top1": np.float32(12.5),
+                 "final_top1": float("inf")},
+            ],
+            winner={
+                "trial": "t000", "family": "ste", "lr": 0.1,
+                "best_top1": 12.5,
+                "time_to_common_acc_s": float("nan"),
+                "run_dir": "/tmp/sweep/trials/t000",
+            },
+            trials={
+                "t000": {"status": "done",
+                         "attempts": np.int64(2),
+                         "resumed": np.bool_(True),
+                         "alerts_critical": 0},
+            },
+        )
+        ev.close()
+        with open(ev.path) as f:
+            recs = [self._strict(l) for l in f if l.strip()]
+        start, done, failed, verdict = recs
+        assert start["workers"] == 2 and isinstance(start["workers"], int)
+        assert done["best_top1"] is None  # NaN -> null
+        assert done["final_top1"] == 12.5
+        assert isinstance(done["final_top1"], float)
+        assert failed["rc"] == -9 and isinstance(failed["rc"], int)
+        assert verdict["ranking"][0]["final_top1"] is None  # Inf -> null
+        assert verdict["ranking"][0]["best_top1"] == 12.5
+        assert verdict["winner"]["time_to_common_acc_s"] is None
+        assert verdict["trials"]["t000"]["resumed"] is True
+        assert verdict["trials"]["t000"]["attempts"] == 2
+        assert verdict["completed"] == 2
 
     def test_health_kind_payloads_roundtrip(self, tmp_path):
         """The real alert/health payload shapes the monitor emits
