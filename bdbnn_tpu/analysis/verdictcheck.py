@@ -4,7 +4,8 @@ The literal-drift class PR 9 fixed ad hoc: ``obs/compare.py`` judges
 the serving SLO through string keys that must agree across FOUR
 places — the ``METRIC_SPECS`` judgment table, the ``_serve_metrics``
 flattener that produces those keys from a verdict, the
-verdict-PRODUCING sites (serve/loadgen.py, serve/http.py) that emit
+verdict-PRODUCING sites (serve/loadgen.py, serve/http.py,
+serve/fleet.py) that emit
 the source fields the flattener reads, and the checked-in golden
 fixture (``tests/fixtures/compare/expected_verdict.json``) that pins
 the metric skeleton. A key renamed in any one of them silently turns
@@ -41,7 +42,14 @@ CHECKER_ID = "verdict-coherence"
 FLATTENER = "_serve_metrics"
 SPECS_NAME = "METRIC_SPECS"
 GOLDEN_FIXTURE = "tests/fixtures/compare/expected_verdict.json"
-PRODUCER_FILES = ("bdbnn_tpu/serve/loadgen.py", "bdbnn_tpu/serve/http.py")
+PRODUCER_FILES = (
+    "bdbnn_tpu/serve/loadgen.py",
+    "bdbnn_tpu/serve/http.py",
+    # the fleet router's verdict assembly: the v6 fleet block and the
+    # v7 fleet_attribution block (whose serve_fleet_* gates
+    # _serve_metrics reads) are produced here
+    "bdbnn_tpu/serve/fleet.py",
+)
 
 
 def _module_literal(tree: ast.Module, name: str) -> Optional[Any]:

@@ -1217,6 +1217,35 @@ def serve_fleet_main(argv) -> int:
     ap.add_argument("--stats-interval-s", type=float, default=1.0)
     ap.add_argument("--events-max-mb", type=float, default=256.0)
     ap.add_argument(
+        "--no-rtrace", dest="rtrace", action="store_false",
+        help="disable cross-host tracing (obs/rtrace.py FleetTracer): "
+        "no x-rtrace propagation, no stitched waterfall, "
+        "fleet_attribution lands null in the verdict",
+    )
+    ap.add_argument(
+        "--rtrace-sample-every", type=int, default=16,
+        help="emit every Nth proxied request's stitched cross-host "
+        "waterfall as an rtrace event (deterministic seeded sampling; "
+        "the slowest-K tail is kept regardless; default 16)",
+    )
+    ap.add_argument(
+        "--rtrace-tail-k", type=int, default=5,
+        help="slowest proxied requests per priority kept as "
+        "cross-host tail exemplars in the v7 fleet_attribution block "
+        "(default 5)",
+    )
+    ap.add_argument(
+        "--scrape-timeout-s", type=float, default=0.5,
+        help="per-host bound on one stats-pump /statsz scrape — a "
+        "wedged host costs this much per pump period, never a stall "
+        "(default 0.5)",
+    )
+    ap.add_argument(
+        "--scrape-stale-after", type=int, default=3,
+        help="consecutive scrape failures before a host's merged "
+        "metrics window is marked stale and excluded (default 3)",
+    )
+    ap.add_argument(
         "--registry", default="",
         help="PRIMARY artifact registry fleet rollouts pull from",
     )
@@ -1275,6 +1304,11 @@ def serve_fleet_main(argv) -> int:
         out=args.out,
         stats_interval_s=args.stats_interval_s,
         events_max_mb=args.events_max_mb,
+        rtrace=args.rtrace,
+        rtrace_sample_every=args.rtrace_sample_every,
+        rtrace_tail_k=args.rtrace_tail_k,
+        scrape_timeout_s=args.scrape_timeout_s,
+        scrape_stale_after=args.scrape_stale_after,
         registry=args.registry,
         host_registries=tuple(args.host_registries),
         swap_to=args.swap_to,

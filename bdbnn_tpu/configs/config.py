@@ -809,6 +809,22 @@ class ServeFleetConfig:
     out: str = ""
     stats_interval_s: float = 1.0
     events_max_mb: float = 256.0
+    # cross-host tracing (obs/rtrace.py FleetTracer): the router mints
+    # a trace id per proxied request, stamps its own stages
+    # (probe_wait/pick/connect/retry_hop/network), propagates the
+    # context via x-rtrace and stitches the backend's x-rtrace-stages
+    # reply into the v7 fleet_attribution block. Same sampling knob
+    # semantics as serve-http's rtrace.
+    rtrace: bool = True
+    rtrace_sample_every: int = 16
+    rtrace_tail_k: int = 5
+    # fleet metrics plane: the stats pump scrapes every host's
+    # /statsz rtrace block with its OWN bounded timeout (a wedged
+    # host costs one timeout per pump period, never a stall) and
+    # `scrape_stale_after` consecutive failures mark that host's
+    # merged window stale.
+    scrape_timeout_s: float = 0.5
+    scrape_stale_after: int = 3
     # fleet blue/green: the PRIMARY registry rollouts pull from, the
     # per-host registry roots replicated into (one per host, in host
     # order; hosts sharing a filesystem may share one root), and the
@@ -904,6 +920,17 @@ class ServeFleetConfig:
             raise ValueError("--stats-interval-s must be > 0")
         if self.events_max_mb < 0:
             raise ValueError("--events-max-mb must be >= 0")
+        if self.rtrace_sample_every < 1:
+            raise ValueError(
+                "--rtrace-sample-every must be >= 1 (1 = every "
+                "request; use --no-rtrace to disable tracing)"
+            )
+        if self.rtrace_tail_k < 0:
+            raise ValueError("--rtrace-tail-k must be >= 0")
+        if self.scrape_timeout_s <= 0:
+            raise ValueError("--scrape-timeout-s must be > 0")
+        if self.scrape_stale_after < 1:
+            raise ValueError("--scrape-stale-after must be >= 1")
         if not 0.0 <= self.swap_at < 1.0:
             raise ValueError(
                 "--swap-at is a fraction of the scenario's offered "

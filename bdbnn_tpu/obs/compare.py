@@ -146,6 +146,19 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("serve_fleet_dropped", "lower", "count"),
     ("serve_fleet_retry_rate", "lower", "rel"),
     ("serve_fleet_host_p99_spread", "lower", "rel"),
+    # v7 fleet_attribution (obs/rtrace.py FleetTracer): the cross-host
+    # waterfall's three tail-attribution gates. Network-stage p99
+    # catches proxy/transport regressions the backend's own stages
+    # can't see; retry-hop share catches tails minted by re-dispatch
+    # (a clean baseline's share is 0.0, so ANY wedged increase is a
+    # regression regardless of --tol-rel — rel tolerance of a zero
+    # baseline is zero); per-host stage-spread max catches one host
+    # going slow in one stage behind a healthy fleet aggregate. v1-v6
+    # verdicts (no fleet_attribution block) leave all three None, so
+    # they skip cleanly in BOTH directions.
+    ("serve_fleet_p99_network_ms", "lower", "rel"),
+    ("serve_fleet_retry_hop_share", "lower", "rel"),
+    ("serve_fleet_stage_spread_max", "lower", "rel"),
     # recipe-search leaderboards (bdbnn_tpu/search/): the winning
     # trial's best top-1 (absolute pp tolerance, like the training
     # accuracies) and its time to the sweep's common-accuracy level —
@@ -243,6 +256,21 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
     out["serve_fleet_retry_rate"] = (fleet or {}).get("retry_rate")
     out["serve_fleet_host_p99_spread"] = (
         (fleet or {}).get("host_p99_spread")
+    )
+    # v7 fleet_attribution block (obs/rtrace.py): network-stage p99
+    # from the router's stitched cross-host windows, the retry-hop
+    # share of cumulative e2e, and the max per-stage cross-host p99
+    # spread. Absent block -> all None, so v1-v6 verdicts skip the
+    # attribution gates cleanly.
+    fa = verdict.get("fleet_attribution")
+    out["serve_fleet_p99_network_ms"] = (
+        ((fa or {}).get("stages") or {}).get("network") or {}
+    ).get("p99_ms")
+    out["serve_fleet_retry_hop_share"] = (
+        (fa or {}).get("retry_hop_share")
+    )
+    out["serve_fleet_stage_spread_max"] = (
+        (fa or {}).get("host_stage_spread_max")
     )
     swap = verdict.get("swap")
     if swap is None:

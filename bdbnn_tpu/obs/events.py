@@ -156,7 +156,16 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
   terminal, then ``done``/``failed`` — the serialization is the
   zero-overlap rollout contract), ``stats`` (the periodic per-host
   table: state, occupancy, proxied/completed/relayed counters,
-  retries by cause — what ``watch`` renders as the fleet banner),
+  retries by cause — what ``watch`` renders as the fleet banner —
+  plus, when the router traces, the fleet metrics plane: ``rtrace``
+  = the router's OWN cross-host trace windows {requests, stitched,
+  unstitched, stage_p99_ms over probe_wait/pick/connect/retry_hop/
+  network, backend_stage_p99_ms, e2e_p99_ms_by_priority,
+  retry_hop_share} and ``host_windows`` = the scraped per-host
+  /statsz windows {hosts: {host: {stale, scrapes, failures,
+  fail_streak, age_s, stage_p99_ms, e2e_p99_ms_by_priority}},
+  merged over FRESH hosts only} — what ``watch`` renders as the
+  live fleet waterfall and per-host stage table),
   ``drain`` (the router's SIGTERM latch fired) and ``stop`` (the
   listener closed after the verdict). The final per-host ledgers
   land in the v6 SLO verdict's ``fleet`` block, which ``compare``
@@ -166,12 +175,18 @@ serving subsystem (``bdbnn_tpu/serve/``) adds four more:
   waterfall — seq, priority, tenant, total_ms, per-stage ms over the
   read/admit/queue/coalesce/dispatch/compute/respond taxonomy;
   deterministic seeded sampling, so the same seed emits the same
-  exemplars) and ``stats`` (the periodic heartbeat: per-stage p99
-  over the rolling windows, end-to-end p99 per priority, the
-  queue-share figure — what ``watch`` renders as the live waterfall
-  and ``/statsz`` mirrors). The final per-priority decomposition,
-  reconciliation identity and tail-exemplar table land in the v4 SLO
-  verdict's ``attribution`` block, not in events
+  exemplars; a FLEET router's sampled waterfall carries the stitched
+  cross-host trace context instead: ``trace`` (the minted 16-hex
+  id), ``host``, ``attempts``, router stages over probe_wait/pick/
+  connect/retry_hop/network, ``backend_total_ms`` + ``backend``
+  (the backend's self-reported stage dict, or null when unstitched)
+  and ``slowest_stage``) and ``stats`` (the periodic heartbeat:
+  per-stage p99 over the rolling windows, end-to-end p99 per
+  priority, the queue-share figure — what ``watch`` renders as the
+  live waterfall and ``/statsz`` mirrors). The final per-priority
+  decomposition, reconciliation identity and tail-exemplar table
+  land in the v4 SLO verdict's ``attribution`` block — or, for the
+  fleet router, the v7 ``fleet_attribution`` block — not in events
 
 The recipe-search harness (``bdbnn_tpu/search/``) adds two:
 

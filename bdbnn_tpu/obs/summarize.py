@@ -345,7 +345,7 @@ def _serving(events) -> Optional[Dict[str, Any]]:
                           "wall_s", "scenario", "per_priority",
                           "per_tenant", "fairness_ratio", "slo",
                           "replicas", "scaling", "swap", "attribution",
-                          "canary", "fleet")
+                          "canary", "fleet", "fleet_attribution")
             }
             if verdict
             else None
@@ -967,6 +967,97 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                             if unshifted else ""
                         )
                     )
+            # the v7 fleet_attribution block: the cross-host
+            # waterfall — router stages + network + the stitched
+            # backend decomposition, the retry-hop share, the
+            # per-host stage spread, the cross-hop reconciliation
+            # identity and the slowest exemplars naming host AND
+            # stage
+            fat = sv.get("fleet_attribution")
+            if fat:
+                recon = fat.get("reconciliation") or {}
+                share = fat.get("retry_hop_share")
+                lines.append(
+                    f"  fleet trace: {fat.get('requests')} requests "
+                    f"traced (stitched {fat.get('stitched')}, "
+                    f"unstitched {fat.get('unstitched')})"
+                    + (
+                        f" | retry-hop share {share:.1%}"
+                        if share is not None else ""
+                    )
+                    + (
+                        f" | cross-hop recon: mean err "
+                        f"{recon.get('mean_abs_err_pct')}%, "
+                        f"{recon.get('violations')} violation(s) "
+                        + ("OK" if recon.get("ok") else "BROKEN")
+                        if recon.get("mean_abs_err_pct") is not None
+                        else ""
+                    )
+                )
+                stage_parts = [
+                    f"{stage} {b['p99_ms']:.1f}"
+                    for stage, b in (fat.get("stages") or {}).items()
+                    if b is not None and b.get("p99_ms") is not None
+                ]
+                if stage_parts:
+                    lines.append(
+                        "    router p99/stage ms  "
+                        + " > ".join(stage_parts)
+                    )
+                bparts = [
+                    f"{stage} {b['p99_ms']:.1f}"
+                    for stage, b in (
+                        fat.get("backend_stages") or {}
+                    ).items()
+                    if b is not None and b.get("p99_ms") is not None
+                ]
+                if bparts:
+                    lines.append(
+                        "    backend p99/stage ms  " + " > ".join(bparts)
+                    )
+                per_host_fat = fat.get("per_host") or {}
+                spread_max = fat.get("host_stage_spread_max")
+                if per_host_fat:
+                    lines.append(
+                        "    per-host backend stage p99 (ms)"
+                        + (
+                            f" | spread max {spread_max}"
+                            if spread_max is not None else ""
+                        )
+                    )
+                    for label in sorted(per_host_fat):
+                        hb = per_host_fat[label]
+                        hparts = [
+                            f"{stage} {b['p99_ms']:.1f}"
+                            for stage, b in (
+                                hb.get("stages") or {}
+                            ).items()
+                            if b is not None
+                            and b.get("p99_ms") is not None
+                        ]
+                        lines.append(
+                            f"      {label} "
+                            f"({hb.get('requests')} req): "
+                            + (
+                                " > ".join(hparts)
+                                if hparts else "no stitched samples"
+                            )
+                        )
+                for p, wfs in sorted((fat.get("tail") or {}).items()):
+                    for wf in wfs[:1]:
+                        waterfall = " + ".join(
+                            f"{stage} {ms:.1f}"
+                            for stage, ms in (
+                                wf.get("stages") or {}
+                            ).items()
+                        )
+                        lines.append(
+                            f"    slowest p{p}: {wf.get('trace')} on "
+                            f"{wf.get('host')} "
+                            f"({wf.get('attempts')} attempt(s)) "
+                            f"{wf.get('total_ms')}ms = {waterfall} | "
+                            f"slowest stage {wf.get('slowest_stage')}"
+                        )
             # the v4 request-path attribution: per-priority p99
             # decomposed by lifecycle stage, the reconciliation
             # identity, and the slowest exemplars' waterfalls

@@ -123,6 +123,66 @@ def _serve_lines(events) -> List[str]:
                 f"{fswap.get('hosts_total')} hosts shifted "
                 "(one at a time — dispatch never loses two hosts)"
             )
+        frt = fleet_stats.get("rtrace")
+        if frt:
+            # the live cross-host waterfall: the router's own stage
+            # windows (probe_wait/pick/connect/retry_hop/network) plus
+            # the stitched backend decomposition, WHILE it happens
+            parts = [
+                f"{stage} {ms:.1f}"
+                for stage, ms in (frt.get("stage_p99_ms") or {}).items()
+                if ms is not None
+            ]
+            share = frt.get("retry_hop_share")
+            lines.append(
+                "trace: fleet p99/stage ms  " + " > ".join(parts)
+                + (
+                    f" | retry-hop share {share:.1%}"
+                    if share is not None else ""
+                )
+                + f" | stitched {frt.get('stitched')}"
+                + f"/{frt.get('requests')}"
+            )
+            bparts = [
+                f"{stage} {ms:.1f}"
+                for stage, ms in (
+                    frt.get("backend_stage_p99_ms") or {}
+                ).items()
+                if ms is not None
+            ]
+            if bparts:
+                lines.append(
+                    "       backend p99/stage ms  " + " > ".join(bparts)
+                )
+        fwin = fleet_stats.get("host_windows")
+        if fwin and fwin.get("hosts"):
+            # the scraped per-host stage table — a host whose /statsz
+            # stopped answering is marked STALE (its window is frozen
+            # and excluded from the merged view), never rendered as
+            # live data
+            lines.append(
+                f"scrape: {fwin.get('hosts_fresh')} fresh / "
+                f"{fwin.get('hosts_stale')} stale host window(s)"
+            )
+            for label in sorted(fwin.get("hosts") or {}):
+                hw = (fwin.get("hosts") or {})[label]
+                parts = [
+                    f"{stage} {ms:.1f}"
+                    for stage, ms in (
+                        hw.get("stage_p99_ms") or {}
+                    ).items()
+                    if ms is not None
+                ]
+                lines.append(
+                    f"  {label:<4} "
+                    + (
+                        "STALE "
+                        f"({hw.get('fail_streak')} failed scrape(s))"
+                        if hw.get("stale")
+                        else " > ".join(parts) if parts
+                        else "no samples yet"
+                    )
+                )
     if digest["fleet_drain"] and verdict is None:
         lines.append(
             f"!! fleet draining (signal "
@@ -431,6 +491,57 @@ def _serve_lines(events) -> List[str]:
                     f"{h.get('proxied')} proxied | p99 "
                     f"{h.get('p99_ms')} ms | retried away "
                     f"{h.get('retried_away')}"
+                )
+        fa = verdict.get("fleet_attribution")
+        if fa:
+            # the final cross-host waterfall: router stages + network
+            # + the stitched backend block, the retry-hop share and
+            # the cross-hop reconciliation disposition
+            stage_parts = [
+                f"{stage} {b['p99_ms']:.1f}"
+                for stage, b in (fa.get("stages") or {}).items()
+                if b is not None and b.get("p99_ms") is not None
+            ]
+            share = fa.get("retry_hop_share")
+            recon = fa.get("reconciliation") or {}
+            lines.append(
+                "  fleet trace: p99/stage ms  " + " > ".join(stage_parts)
+                + (
+                    f" | retry-hop share {share:.1%}"
+                    if share is not None else ""
+                )
+                + (
+                    f" | stage spread {fa.get('host_stage_spread_max')}"
+                    if fa.get("host_stage_spread_max") is not None
+                    else ""
+                )
+                + (
+                    "" if recon.get("ok") in (True, None)
+                    else " | CROSS-HOP RECONCILIATION BROKEN"
+                )
+            )
+            bparts = [
+                f"{stage} {b['p99_ms']:.1f}"
+                for stage, b in (fa.get("backend_stages") or {}).items()
+                if b is not None and b.get("p99_ms") is not None
+            ]
+            if bparts:
+                lines.append(
+                    "    backend p99/stage ms  " + " > ".join(bparts)
+                )
+            for p, wfs in sorted((fa.get("tail") or {}).items()):
+                if not wfs:
+                    continue
+                wf = wfs[0]  # the slowest cross-host exemplar
+                waterfall = " + ".join(
+                    f"{stage} {ms:.1f}"
+                    for stage, ms in (wf.get("stages") or {}).items()
+                )
+                lines.append(
+                    f"    slowest p{p}: {wf.get('trace')} on "
+                    f"{wf.get('host')} ({wf.get('attempts')} "
+                    f"attempt(s)) {wf.get('total_ms')}ms = {waterfall}"
+                    f" | slowest stage {wf.get('slowest_stage')}"
                 )
         att = verdict.get("attribution")
         if att:

@@ -95,6 +95,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from bdbnn_tpu.obs.events import jsonsafe
 from bdbnn_tpu.obs.health import DetectorState
+from bdbnn_tpu.obs.rtrace import (
+    STAGE_HEADER,
+    TRACE_HEADER,
+    FleetTracer,
+    HostStatsWindows,
+    encode_trace_context,
+)
 from bdbnn_tpu.serve.http import PREDICT_PATH, _REASONS
 from bdbnn_tpu.serve.loadgen import _pct, recv_response
 
@@ -304,6 +311,10 @@ class FleetRouter:
         host_registries: Tuple[str, ...] = (),
         swap_host_timeout_s: float = 120.0,
         on_event: Optional[Callable[..., Any]] = None,
+        tracer: Optional[FleetTracer] = None,
+        scrape_timeout_s: float = 0.5,
+        scrape_stale_after: int = 3,
+        scrape_window: int = 64,
     ):
         self.host = host
         self.port = int(port)
@@ -321,6 +332,18 @@ class FleetRouter:
         self.host_registries = tuple(host_registries)
         self.swap_host_timeout_s = float(swap_host_timeout_s)
         self.on_event = on_event
+        # cross-host tracing (obs/rtrace.py): when wired, every
+        # proxied predict carries a minted trace context and its
+        # router stages + the backend's stitched stage block roll into
+        # the v7 fleet_attribution. The scrape plane (HostStatsWindows,
+        # internally locked) merges each host's /statsz rtrace block
+        # on the stats pump's bounded-timeout schedule.
+        self.tracer = tracer
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self.scrape = HostStatsWindows(
+            window=int(scrape_window),
+            stale_after=int(scrape_stale_after),
+        )
         # ONE reentrant lock for the whole router (host table included):
         # reentrancy makes an accidental nested acquire harmless, and
         # the condition below shares it so drain's inflight-zero wait
@@ -553,7 +576,25 @@ class FleetRouter:
         """One request/response exchange with a backend over a fresh
         connection (connection: close — the backend's drain grace then
         never waits on the router's idle keep-alives)."""
+        return self._request_host_timed(
+            h, method, path, headers, body, timeout=timeout
+        )[:3]
+
+    def _request_host_timed(
+        self, h: HostState, method: str, path: str,
+        headers: Dict[str, str], body: bytes, *, timeout: float,
+    ) -> Tuple[int, Dict[str, str], bytes, float, float]:
+        """:meth:`_request_host` plus the trace's connect/exchange
+        split, both measured on the ROUTER's clock: ``connect_ms`` is
+        the TCP establish, ``exchange_ms`` the wall from first request
+        byte sent to response fully received. The trace charges the
+        attempt's full wall (not these timers alone) so the stage sum
+        reconciles with the trace total by construction; the
+        ``network`` stage is the wall's residual minus the backend's
+        self-reported span — never a cross-clock subtract."""
+        t0 = time.perf_counter()
         sock = socket.create_connection((h.host, h.port), timeout=timeout)
+        t_conn = time.perf_counter()
         try:
             sock.settimeout(timeout)
             head = (
@@ -562,7 +603,8 @@ class FleetRouter:
                 "connection: close\r\n"
             )
             for name in (
-                "x-priority", "x-tenant", "x-model", "content-type"
+                "x-priority", "x-tenant", "x-model", "content-type",
+                TRACE_HEADER,
             ):
                 if name in headers:
                     head += f"{name}: {headers[name]}\r\n"
@@ -570,9 +612,14 @@ class FleetRouter:
             sock.sendall(head.encode("latin-1") + body)
             rfile = sock.makefile("rb")
             try:
-                return recv_response(rfile)
+                status, rheaders, rbody = recv_response(rfile)
             finally:
                 rfile.close()
+            return (
+                status, rheaders, rbody,
+                (t_conn - t0) * 1000.0,
+                (time.perf_counter() - t_conn) * 1000.0,
+            )
         finally:
             try:
                 sock.close()
@@ -581,15 +628,32 @@ class FleetRouter:
 
     def _proxy_predict(
         self, headers: Dict[str, str], body: bytes, priority: int,
+        trace=None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """The retry/relay core: try distinct hosts on transport
         failures (ledgered per host and per cause, backoff between
-        attempts); RELAY the first well-formed response verbatim."""
+        attempts); RELAY the first well-formed response verbatim.
+
+        When tracing is wired, the router stages stamp here:
+        ``probe_wait`` (request parse -> first pick, i.e. the cv wait
+        on probed router state), ``pick`` per host selection,
+        ``connect``/``network`` on the successful attempt, and one
+        ``retry_hop`` per failed attempt — the attempt's wall PLUS the
+        backoff sleep it incurred, charged to that attempt."""
         tried: set = set()
+        if trace is not None:
+            trace.stamp("probe_wait")
+            headers = dict(headers)
+            headers[TRACE_HEADER] = encode_trace_context(
+                trace.trace_id, trace.seq, priority,
+                headers.get("x-tenant"),
+            )
         for attempt in range(self.max_attempts):
             h = self._pick_host(tried)
             if h is None:
                 break
+            if trace is not None:
+                trace.stamp("pick")
             tried.add(h.label)
             with h._lock:
                 h.inflight += 1
@@ -597,7 +661,9 @@ class FleetRouter:
             t0 = time.perf_counter()
             cause = None
             try:
-                status, rheaders, rbody = self._request_host(
+                (
+                    status, rheaders, rbody, connect_ms, exchange_ms,
+                ) = self._request_host_timed(
                     h, "POST", PREDICT_PATH, headers, body,
                     timeout=self.proxy_timeout_s,
                 )
@@ -635,8 +701,31 @@ class FleetRouter:
                         attempt, self.backoff_base_s,
                         self.backoff_cap_s,
                     ))
+                if trace is not None:
+                    trace.stamp("retry_hop")
                 continue
-            lat_ms = (time.perf_counter() - t0) * 1000.0
+            t_done = time.perf_counter()
+            lat_ms = (t_done - t0) * 1000.0
+            if trace is not None:
+                # reconciliation by construction (the backend header's
+                # own discipline, one hop up): charge the attempt's
+                # FULL wall since the last stamp — `connect` gets the
+                # measured TCP establish, and the residual (exchange
+                # plus the router's own pre-connect/post-read slop the
+                # socket timer cannot see) goes to the stitcher, which
+                # splits it into backend span + `network`. The stage
+                # sum then equals the trace wall exactly, so the
+                # cross-hop identity never flags scheduler slop on a
+                # contended box as misattribution.
+                elapsed_ms = (t_done - trace._last) * 1000.0
+                conn_ms = min(connect_ms, elapsed_ms)
+                trace.add("connect", conn_ms)
+                trace.attempts = attempt + 1
+                self.tracer.stitch(
+                    trace, elapsed_ms - conn_ms,
+                    rheaders.get(STAGE_HEADER), h.label,
+                )
+                trace.sync(at=t_done)
             with h._lock:
                 h.inflight -= 1
                 h.consecutive_failures = 0
@@ -788,6 +877,12 @@ class FleetRouter:
                         "got": raw_p,
                     }).encode()
                 )
+        # the trace begins BEFORE the cv block so probe_wait charges
+        # the router-state wait a request actually experienced
+        trace = (
+            self.tracer.begin(priority, headers.get("x-tenant"))
+            if self.tracer is not None else None
+        )
         with self._cv:
             if self._t_started is None:
                 # the verdict wall clock starts at the first routed
@@ -797,13 +892,26 @@ class FleetRouter:
             if self._draining.is_set():
                 self._counts[priority]["shed_draining"] += 1
                 self._shed_draining += 1
+                if trace is not None:
+                    self.tracer.abort(trace)
                 return 503, {
                     "content-type": "application/json",
                     "retry-after": str(self.retry_after_s),
                 }, b'{"error": "draining"}'
             self._inflight += 1
         try:
-            return self._proxy_predict(headers, body, priority)
+            status, out_headers, out_body = self._proxy_predict(
+                headers, body, priority, trace
+            )
+            if trace is not None:
+                # only a relayed 200 is a served request; a relayed
+                # shed/reject or the router's own 503 must never read
+                # as a fast fleet serve
+                if status == 200:
+                    self.tracer.finish(trace)
+                else:
+                    self.tracer.abort(trace)
+            return status, out_headers, out_body
         finally:
             with self._cv:
                 self._inflight -= 1
@@ -992,6 +1100,33 @@ class FleetRouter:
 
     # -- reporting ------------------------------------------------------
 
+    def scrape_host_stats(self) -> None:
+        """One merge pass of the fleet metrics plane: GET every host's
+        ``/statsz`` with the scrape's OWN bounded timeout and fold the
+        ``rtrace`` block into that host's rolling windows. A wedged or
+        dead host costs at most ``scrape_timeout_s`` and one failure
+        count — it can never stall the pump; after ``stale_after``
+        consecutive failures its window reads stale and drops out of
+        the merged view. Called from the stats pump, never from the
+        request path."""
+        for h in self.hosts:
+            if self._stop.is_set():
+                return
+            try:
+                status, _, rbody = self._request_host(
+                    h, "GET", "/statsz", {}, b"",
+                    timeout=self.scrape_timeout_s,
+                )
+                block = None
+                if status == 200:
+                    block = (json.loads(rbody) or {}).get("rtrace")
+                if isinstance(block, dict):
+                    self.scrape.record(h.label, block)
+                else:
+                    self.scrape.record_failure(h.label)
+            except Exception:
+                self.scrape.record_failure(h.label)
+
     def stats(self) -> Dict[str, Any]:
         hosts: Dict[str, Any] = {}
         for h in self.hosts:
@@ -1013,6 +1148,13 @@ class FleetRouter:
                 "hosts": hosts,
                 "swap": swap,
             }
+        # the live fleet metrics plane: the router's own cross-host
+        # trace windows plus the per-host scraped windows (both
+        # internally locked — never under the router lock above)
+        out["rtrace"] = (
+            self.tracer.stats() if self.tracer is not None else None
+        )
+        out["host_windows"] = self.scrape.snapshot()
         return jsonsafe(out)
 
     def accounting(self) -> Dict[str, Any]:
@@ -1125,11 +1267,13 @@ def fleet_slo_verdict(
     drained_clean: bool = True,
     client: Optional[Dict[str, Any]] = None,
     slo_p99_ms: float = 0.0,
+    fleet_attribution: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
-    """Build the v6 verdict from the router's ledger: the same
+    """Build the v7 verdict from the router's ledger: the same
     per-priority skeleton as the HTTP front end's verdict (so
     ``compare``/``summarize`` read a fleet run unchanged) plus the
-    ``fleet`` block."""
+    ``fleet`` block and — when the router traced — the
+    ``fleet_attribution`` cross-host waterfall block."""
     from bdbnn_tpu.serve.loadgen import slo_verdict
 
     lat_p = accounting["latencies_ms_by_priority"]
@@ -1193,6 +1337,7 @@ def fleet_slo_verdict(
         client=client,
         slo=slo,
         fleet=fleet,
+        fleet_attribution=fleet_attribution,
     )
 
 
@@ -1295,6 +1440,16 @@ def _serve_fleet_body(cfg, handler, on_arrival=None) -> Dict[str, Any]:
     events = EventWriter(
         run_dir, max_bytes=int(cfg.events_max_mb * 2**20)
     )
+    tracer = None
+    if cfg.rtrace:
+        tracer = FleetTracer(
+            sample_every=cfg.rtrace_sample_every,
+            tail_k=cfg.rtrace_tail_k,
+            seed=cfg.seed,
+            on_sample=lambda wf: events.emit(
+                "rtrace", phase="request", **wf
+            ),
+        )
     router = FleetRouter(
         parse_hosts(cfg.hosts),
         host=cfg.host,
@@ -1312,6 +1467,9 @@ def _serve_fleet_body(cfg, handler, on_arrival=None) -> Dict[str, Any]:
         host_registries=cfg.host_registries,
         swap_host_timeout_s=cfg.swap_host_timeout_s,
         on_event=lambda kind, **f: events.emit(kind, **f),
+        tracer=tracer,
+        scrape_timeout_s=cfg.scrape_timeout_s,
+        scrape_stale_after=cfg.scrape_stale_after,
     )
     host, port = router.start()
     events.emit(
@@ -1340,6 +1498,10 @@ def _serve_fleet_body(cfg, handler, on_arrival=None) -> Dict[str, Any]:
 
     def stats_pump():
         while not stats_stop.wait(cfg.stats_interval_s):
+            # scrape first so the heartbeat carries windows no older
+            # than one pump period; each host is bounded by the
+            # scrape's own timeout, so a wedged host cannot stall this
+            router.scrape_host_stats()
             events.emit("fleet", phase="stats", **router.stats())
 
     pump = threading.Thread(target=stats_pump, daemon=True)
@@ -1458,6 +1620,9 @@ def _serve_fleet_body(cfg, handler, on_arrival=None) -> Dict[str, Any]:
         drained_clean=drained_clean,
         client=client_raw,
         slo_p99_ms=cfg.slo_p99_ms,
+        fleet_attribution=(
+            tracer.attribution() if tracer is not None else None
+        ),
     )
     events.emit("serve", phase="verdict", **verdict)
     events.emit("fleet", phase="stop", host=host, port=port)

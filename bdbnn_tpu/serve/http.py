@@ -59,7 +59,13 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from bdbnn_tpu.obs.events import jsonsafe
-from bdbnn_tpu.obs.rtrace import pop_future_answered_by
+from bdbnn_tpu.obs.rtrace import (
+    STAGE_HEADER,
+    TRACE_HEADER,
+    encode_stage_header,
+    parse_trace_context,
+    pop_future_answered_by,
+)
 from bdbnn_tpu.serve.admission import (
     ADMIT,
     DEFAULT_TENANT,
@@ -353,7 +359,14 @@ class HttpFrontEnd:
             if h in (b"\r\n", b"\n", b""):
                 break
             name, _, value = h.decode("latin-1").partition(":")
-            headers[name.strip().lower()] = value.strip()
+            name = name.strip().lower()
+            value = value.strip()
+            if name == TRACE_HEADER and name in headers:
+                # a DUPLICATED trace context is ambiguous (which hop
+                # minted it?) — poison it so the adopt path falls
+                # back to a fresh local trace instead of guessing
+                value = ""
+            headers[name] = value
         n = int(headers.get("content-length", 0) or 0)
         if n > self.max_body_bytes:
             return method, path, headers, None, t_recv  # signals 413
@@ -363,6 +376,7 @@ class HttpFrontEnd:
     def _respond(
         self, writer, status: int, obj: Any, *,
         retry_after: bool = False, close: bool = False,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> None:
         body = json.dumps(jsonsafe(obj)).encode()
         head = (
@@ -372,6 +386,8 @@ class HttpFrontEnd:
         )
         if retry_after:
             head += f"retry-after: {self.retry_after_s}\r\n"
+        for name, value in (extra_headers or {}).items():
+            head += f"{name}: {value}\r\n"
         if close:
             head += "connection: close\r\n"
         writer.write(head.encode("latin-1") + b"\r\n" + body)
@@ -533,6 +549,12 @@ class HttpFrontEnd:
                 priority, tenant,
                 t_start=t_recv if t_recv is not None else t0,
             )
+            # adopt an inbound fleet trace context (x-rtrace from the
+            # FleetRouter) so the local waterfall continues the SAME
+            # trace; the hardened parser maps ANY malformed header —
+            # garbage, oversized, junk from a non-fleet client — to
+            # None, i.e. a fresh local trace, never a 500
+            trace.ctx = parse_trace_context(headers.get(TRACE_HEADER))
             trace.stamp("read")
         # in-flight covers the WHOLE predict — admission through the
         # written response — so drain's inflight-zero wait cannot race
@@ -704,7 +726,31 @@ class HttpFrontEnd:
             # the router's per-host completed ledger can be audited
             # against the hosts' own claims
             payload_out["served_by"] = self.server_id
-        self._respond(writer, 200, payload_out)
+        extra_headers = None
+        if trace is not None and trace.ctx is not None:
+            # fleet-traced request: return the server-side stage
+            # decomposition in the response header the router stitches.
+            # The self-reported span ends HERE (at header build) — the
+            # final socket write is on the far side of the bytes, so
+            # the router's `network` stage absorbs it by construction;
+            # the pre-write gap since the last stamp (future wakeup +
+            # encode) is charged to `respond` so the header's stage sum
+            # equals its total exactly
+            total_ms = (time.perf_counter() - trace.t0) * 1000.0
+            stages = dict(trace.stages)
+            pre_write = total_ms - sum(stages.values())
+            if pre_write > 0:
+                stages["respond"] = (
+                    stages.get("respond", 0.0) + pre_write
+                )
+            extra_headers = {
+                STAGE_HEADER: encode_stage_header(
+                    trace.ctx["id"], total_ms, stages
+                ),
+            }
+        self._respond(
+            writer, 200, payload_out, extra_headers=extra_headers
+        )
         await writer.drain()
         if trace is not None:
             # respond span: future wakeup + encode + socket write; the

@@ -92,7 +92,17 @@ VERDICT_NAME = "verdict.json"
 # ``serve_fleet_dropped`` / ``serve_fleet_retry_rate`` /
 # ``serve_fleet_host_p99_spread`` gates. Null on single-host runs,
 # so v1-v5 consumers keep working unchanged.
-VERDICT_SCHEMA_VERSION = 6
+# v7: the ``fleet_attribution`` block (obs/rtrace.py FleetTracer via
+# serve/fleet.py) — the cross-host waterfall: per-priority e2e
+# p50/p99 decomposed into router stages (probe_wait/pick/connect/
+# retry_hop) + network + the backend's stitched stage blocks,
+# retry-hop share, per-host stage spread, slowest-K cross-host
+# exemplars naming host AND stage, and the cross-hop reconciliation
+# identity with tolerance — the sources of ``compare``'s
+# ``serve_fleet_p99_network_ms`` / ``serve_fleet_retry_hop_share`` /
+# ``serve_fleet_stage_spread_max`` gates. Null when router tracing
+# is off, so v1-v6 consumers keep working unchanged.
+VERDICT_SCHEMA_VERSION = 7
 
 
 def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -705,6 +715,7 @@ def slo_verdict(
     attribution: Optional[Dict[str, Any]] = None,
     canary: Optional[Dict[str, Any]] = None,
     fleet: Optional[Dict[str, Any]] = None,
+    fleet_attribution: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble the deterministic strict-JSON SLO verdict.
 
@@ -741,7 +752,14 @@ def slo_verdict(
     hosts ``dropped`` and the per-host p99 spread — the source of
     ``compare``'s ``serve_fleet_dropped`` / ``serve_fleet_retry_rate``
     / ``serve_fleet_host_p99_spread`` gates. Null on single-host
-    runs."""
+    runs. The router's FleetTracer (obs/rtrace.py) adds the v7
+    ``fleet_attribution`` block: the cross-host waterfall — router
+    stages + network + stitched backend stages per priority, retry-hop
+    share, per-host stage spread, the cross-hop reconciliation
+    identity and the slowest-K exemplars naming host and stage — the
+    source of ``compare``'s ``serve_fleet_p99_network_ms`` /
+    ``serve_fleet_retry_hop_share`` / ``serve_fleet_stage_spread_max``
+    gates. Null when router tracing is off."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
@@ -783,6 +801,7 @@ def slo_verdict(
         "attribution": attribution,
         "canary": canary,
         "fleet": fleet,
+        "fleet_attribution": fleet_attribution,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (

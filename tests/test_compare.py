@@ -277,3 +277,79 @@ class TestArtifactExtraction:
     def test_needs_two_sources(self):
         with pytest.raises(ValueError, match="baseline"):
             compare_runs(["one"])
+
+
+class TestFleetAttributionVersionSkew:
+    """The three v7 fleet-attribution metrics must skip cleanly when
+    either side predates the fleet tracing plane (pinned per the
+    satellite): a v6 verdict carries no fleet_attribution block, so
+    the metrics land None on that side -> no row, never a phantom
+    verdict or a crash."""
+
+    V6 = {
+        "serve_verdict": 6,
+        "p99_ms": 12.0, "throughput_rps": 90.0, "shed_rate": 0.0,
+        "provenance": {"recipe": {"arch": "resnet8_tiny",
+                                  "dataset": "cifar10"}},
+    }
+
+    def test_v6_verdict_extracts_none_for_fleet_trace_metrics(self):
+        from bdbnn_tpu.obs.compare import _serve_metrics
+
+        m = _serve_metrics(dict(self.V6))
+        assert m["serve_fleet_p99_network_ms"] is None
+        assert m["serve_fleet_retry_hop_share"] is None
+        assert m["serve_fleet_stage_spread_max"] is None
+
+    def test_v6_vs_v7_skips_both_directions(self, tmp_path):
+        v7 = dict(self.V6)
+        v7["serve_verdict"] = 7
+        v7["fleet_attribution"] = {
+            "stages": {"network": {"p99_ms": 3.5, "n": 50}},
+            "retry_hop_share": 0.0,
+            "host_stage_spread_max": 1.2,
+        }
+        a = tmp_path / "v6.json"
+        b = tmp_path / "v7.json"
+        a.write_text(json.dumps(self.V6))
+        b.write_text(json.dumps(v7))
+        for pair in ([str(a), str(b)], [str(b), str(a)]):
+            result = compare_runs(pair)
+            judged = {
+                m["metric"]
+                for m in result["comparisons"][0]["metrics"]
+            }
+            assert "serve_fleet_p99_network_ms" not in judged
+            assert "serve_fleet_retry_hop_share" not in judged
+            assert "serve_fleet_stage_spread_max" not in judged
+            assert result["verdict"] == "pass"
+
+    def test_v7_both_sides_judges_fleet_trace_metrics(self, tmp_path):
+        def v7(network_p99, share):
+            v = dict(self.V6)
+            v["serve_verdict"] = 7
+            v["fleet_attribution"] = {
+                "stages": {"network": {"p99_ms": network_p99,
+                                       "n": 50}},
+                "retry_hop_share": share,
+                "host_stage_spread_max": 1.0,
+            }
+            return v
+
+        a = tmp_path / "clean.json"
+        b = tmp_path / "wedged.json"
+        a.write_text(json.dumps(v7(3.0, 0.0)))
+        b.write_text(json.dumps(v7(3.1, 0.25)))
+        # a zero-baseline share leaves zero relative headroom: any
+        # retry-hop time in the candidate regresses regardless of how
+        # wide --tol-rel is opened (the acceptance compare gate)
+        result = compare_runs([str(a), str(b)], tol_rel=5.0)
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert rows["serve_fleet_retry_hop_share"]["verdict"] == (
+            "regression"
+        )
+        assert rows["serve_fleet_p99_network_ms"]["verdict"] == "ok"
+        assert result["verdict"] == "regression"

@@ -562,6 +562,124 @@ class TestStrictRfc8259:
         assert p["host"] == "h0" and x["attempt"] == 1
         assert w["seconds"] is None
 
+    def test_fleet_trace_plane_payloads_roundtrip(self, tmp_path):
+        """The fleet tracing/metrics-plane payload shapes (v7): the
+        ``rtrace`` + ``host_windows`` blocks riding the fleet
+        phase=stats event, and the cross-host waterfall riding the
+        rtrace phase=request event — with adversarial values in the
+        numeric slots. A NaN stage p99 in the merged window must land
+        as null (never a bare token), numpy counters must unwrap, and
+        the nested per-host / per-stage / per-attempt structures must
+        survive strict-RFC-8259 parsing."""
+        ev = EventWriter(str(tmp_path))
+        s = ev.emit(
+            "fleet",
+            phase="stats",
+            role="fleet-router",
+            hosts_total=np.int64(2),
+            hosts_ready=2,
+            rtrace={
+                "requests": np.int64(96),
+                "stitched": np.int64(90),
+                "unstitched": 6,
+                "retry_hop_share": np.float32(0.083),
+                "stages": {
+                    "probe_wait": {"p99_ms": np.float32(0.2),
+                                   "n": np.int64(96)},
+                    "retry_hop": {"p99_ms": float("nan"), "n": 8},
+                    "network": {"p99_ms": np.float64(3.5),
+                                "n": np.int64(90)},
+                },
+                "backend_stages": {
+                    "queue": {"p99_ms": np.float32(4.0), "n": 90},
+                    "compute": {"p99_ms": float("inf"),
+                                "n": np.int64(90)},
+                },
+                "reconciliation": {
+                    "violations": np.int64(0),
+                    "mean_abs_err_pct": np.float32(0.6),
+                    "ok": np.bool_(True),
+                },
+            },
+            host_windows={
+                "hosts_fresh": np.int64(1),
+                "hosts_stale": 1,
+                "hosts": {
+                    "h0": {
+                        "stale": np.bool_(False),
+                        "failures": np.int64(0),
+                        "stage_p99_ms": {
+                            "queue": np.float32(4.1),
+                            "compute": float("nan"),
+                            "respond": None,
+                        },
+                        "queue_share": np.float32(0.3),
+                    },
+                    "h1": {
+                        "stale": np.bool_(True),
+                        "failures": np.int64(3),
+                        "stage_p99_ms": {"queue": None,
+                                         "compute": None},
+                        "queue_share": None,
+                    },
+                },
+                "merged": {
+                    "stage_p99_ms": {"queue": np.float64(4.1),
+                                     "compute": float("nan")},
+                },
+            },
+        )
+        w = ev.emit(
+            "rtrace",
+            phase="request",
+            trace="0123456789abcdef",
+            host="h1",
+            priority=np.int64(0),
+            attempts=np.int64(2),
+            total_ms=np.float32(22.5),
+            stages={
+                "probe_wait": np.float32(0.1),
+                "pick": 0.02,
+                "connect": np.float32(0.4),
+                "retry_hop": np.float64(10.0),
+                "network": float("nan"),
+            },
+            backend_total_ms=np.float32(11.0),
+            backend={
+                "queue": np.float32(3.0),
+                "compute": np.float64(7.5),
+                "respond": float("inf"),
+            },
+            slowest_stage="retry_hop",
+        )
+        ev.close()
+        with open(ev.path) as f:
+            lines = [self._strict(l) for l in f if l.strip()]
+        rt = lines[0]["rtrace"]
+        assert rt["stages"]["retry_hop"]["p99_ms"] is None  # NaN
+        assert rt["backend_stages"]["compute"]["p99_ms"] is None
+        assert rt["stages"]["network"]["p99_ms"] == 3.5
+        assert isinstance(rt["requests"], int) and rt["requests"] == 96
+        assert rt["reconciliation"]["ok"] is True
+        hw = lines[0]["host_windows"]
+        assert hw["hosts"]["h0"]["stage_p99_ms"]["compute"] is None
+        assert hw["hosts"]["h0"]["stage_p99_ms"]["queue"] == (
+            pytest.approx(4.1, abs=1e-3)
+        )
+        assert hw["hosts"]["h1"]["stale"] is True
+        assert isinstance(hw["hosts"]["h1"]["failures"], int)
+        assert hw["merged"]["stage_p99_ms"]["compute"] is None
+        wf = lines[1]
+        assert wf["trace"] == "0123456789abcdef"
+        assert wf["stages"]["network"] is None  # NaN -> null
+        assert wf["stages"]["retry_hop"] == 10.0
+        assert wf["backend"]["respond"] is None  # Inf -> null
+        assert isinstance(wf["attempts"], int) and wf["attempts"] == 2
+        assert wf["slowest_stage"] == "retry_hop"
+        # the emit() return values match what was written
+        assert s["rtrace"]["stages"]["retry_hop"]["p99_ms"] is None
+        assert w["stages"]["network"] is None
+
     def test_resilience_kind_payloads_roundtrip(self, tmp_path):
         """The extended pod-resilience payload shapes (train/loop.py):
         coordinated checkpoint/preempt records and an elastic-resume

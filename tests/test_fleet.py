@@ -643,7 +643,7 @@ class TestFleetVerdict:
         finally:
             a.stop()
             b.stop()
-        assert verdict["serve_verdict"] == 6
+        assert verdict["serve_verdict"] == 7
         assert verdict["mode"] == "fleet"
         flt = verdict["fleet"]
         assert flt["dropped"] == 0
@@ -1003,7 +1003,7 @@ class TestFleetEndToEnd:
         res = run_serve_fleet(cfg, on_arrival=on_arrival)
         v = res["verdict"]
         assert killed, "the kill hook never fired"
-        assert v["serve_verdict"] == 6
+        assert v["serve_verdict"] == 7
         # zero client-visible drops across the host death: every
         # request got SOME response — 200 or an explicit shed
         assert v["client"]["dropped"] == 0
@@ -1076,6 +1076,220 @@ class TestFleetEndToEnd:
         verdict_path = os.path.join(res["run_dir"], "verdict.json")
         assert cli_main(
             ["compare", verdict_path, str(cand), "--json"]
+        ) == 3
+
+
+class TestFleetTraceAcceptance:
+    """THE fleet tracing acceptance (v7): the SAME 2 real serve-http
+    hosts serve a clean run and a wedged run (SIGSTOP one host
+    mid-run — its kernel keeps accepting connections but nothing ever
+    answers, so every exchange parked on it times out and retry-hops
+    to the peer). The v7 verdict must attribute the wedged client
+    tail to retry_hop/network while the backend stage p99s stay
+    flat, cross-hop reconciliation must hold on every traced
+    request, the stats pump must mark the wedged host's window
+    stale, and ``compare`` clean-vs-wedged must exit 3 on
+    serve_fleet_retry_hop_share even with --tol-rel wide open."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self, exported_artifact, tmp_path_factory):
+        """Same formation quarantine as TestFleetEndToEnd (its fleet
+        is not reusable here: that test SIGTERMs h0)."""
+        from conftest import retry_once_flaky
+
+        art_dir, _ = exported_artifact
+
+        def attempt(i):
+            tag = "tracefleet" if i == 0 else "tracefleet_retry"
+            roots = [
+                tmp_path_factory.mktemp(f"{tag}_h{j}")
+                for j in range(2)
+            ]
+            procs, ports = _form_fleet(art_dir, roots)
+            return {
+                "art": art_dir,
+                "procs": procs,
+                "ports": ports,
+                "roots": roots,
+            }
+
+        fleet = retry_once_flaky(
+            attempt,
+            note=(
+                "fleet host cluster attempt 1 never formed "
+                "(serve-http subprocess bring-up transient on "
+                "contended boxes; pod_worker precedent)"
+            ),
+        )
+        yield fleet
+        _reap_hosts(fleet["procs"], timeout=30)
+
+    def _cfg(self, fleet, run_dir, **kw):
+        base = dict(
+            hosts=tuple(
+                f"127.0.0.1:{p}" for p in fleet["ports"]
+            ),
+            artifact=fleet["art"],
+            log_path=run_dir,
+            scenario="poisson",
+            rate=60.0,
+            requests=50,
+            concurrency=8,
+            seed=0,
+            probe_interval_s=0.1,
+            health_debounce=2,
+            max_attempts=3,
+            proxy_timeout_s=30.0,
+            ready_timeout_s=60.0,
+            stats_interval_s=0.2,
+            rtrace_sample_every=1,
+            scrape_timeout_s=0.2,
+            scrape_stale_after=2,
+        )
+        base.update(kw)
+        return ServeFleetConfig(**base)
+
+    def test_clean_then_wedged_attribution_and_compare_gate(
+        self, fleet, tmp_path
+    ):
+        from bdbnn_tpu.cli import main as cli_main
+        from bdbnn_tpu.obs.compare import compare_runs
+        from bdbnn_tpu.obs.events import read_events
+        from bdbnn_tpu.obs.summarize import summarize_run
+
+        # ---- clean pass: both hosts healthy -----------------------
+        clean = run_serve_fleet(
+            self._cfg(fleet, str(tmp_path / "clean"))
+        )
+        cv = clean["verdict"]
+        assert cv["serve_verdict"] == 7
+        assert cv["client"]["dropped"] == 0
+        assert cv["requests_failed"] == 0
+        cfa = cv["fleet_attribution"]
+        assert cfa is not None
+        # every relayed request is traced AND stitched: the backends
+        # adopted the router's x-rtrace and echoed their stage header
+        assert cfa["requests"] > 0
+        assert cfa["stitched"] == cfa["requests"]
+        assert cfa["unstitched"] == 0
+        # clean fleet: the retry-hop share is a MEASURED zero (never
+        # None) — that is what leaves zero relative headroom below
+        assert cfa["retry_hop_share"] == 0.0
+        assert cfa["stages"]["network"]["p99_ms"] > 0.0
+        assert cfa["backend_stages"]["compute"]["p99_ms"] > 0.0
+        # two-clock discipline: cross-hop reconciliation holds on
+        # every traced request (router stages + backend sum == e2e)
+        crec = cfa["reconciliation"]
+        assert crec["ok"] is True
+        assert crec["violations"] == 0
+        assert crec["stitched"] == cfa["requests"]
+        # both hosts served -> the per-stage host spread is judgeable
+        assert cfa["host_stage_spread_max"] is not None
+        clean_vp = os.path.join(clean["run_dir"], "verdict.json")
+
+        # ---- wedged pass: SIGSTOP h0 mid-run ----------------------
+        wedged_at = []
+
+        def on_arrival(i):
+            if not wedged_at and i >= 10:
+                wedged_at.append(i)
+                fleet["procs"][0].send_signal(signal.SIGSTOP)
+
+        try:
+            wedged = run_serve_fleet(
+                self._cfg(
+                    fleet,
+                    str(tmp_path / "wedged"),
+                    requests=60,
+                    proxy_timeout_s=0.75,
+                ),
+                on_arrival=on_arrival,
+            )
+        finally:
+            fleet["procs"][0].send_signal(signal.SIGCONT)
+        wv = wedged["verdict"]
+        assert wedged_at, "the wedge hook never fired"
+        assert wv["serve_verdict"] == 7
+        # the wedged host never DROPS a client: every parked exchange
+        # times out at the router and retry-hops to the peer
+        assert wv["client"]["dropped"] == 0
+        assert wv["fleet"]["hosts"]["h0"]["retries"]["timeout"] > 0
+        wfa = wv["fleet_attribution"]
+        # the client tail is attributed to retry_hop: wedged attempts
+        # charge their wall + backoff to the hop stage...
+        assert wfa["retry_hop_share"] > 0.0
+        rh = wfa["stages"]["retry_hop"]
+        assert rh is not None and rh["p99_ms"] > 0.0
+        # ...while the backend stage p99s stay flat — the surviving
+        # host's self-reported decomposition is untouched by the
+        # router-side stall (proxy_timeout_s dominates every backend
+        # stage by construction)
+        backend_p99s = [
+            blk["p99_ms"]
+            for blk in (wfa["backend_stages"] or {}).values()
+            if blk is not None and blk.get("p99_ms") is not None
+        ]
+        assert backend_p99s
+        assert max(backend_p99s) < rh["p99_ms"]
+        # reconciliation still holds on every traced request — the
+        # timed-out attempts are charged to retry_hop, not smeared
+        # into an unexplained residual
+        wrec = wfa["reconciliation"]
+        assert wrec["ok"] is True
+        assert wrec["violations"] == 0
+        # the sampled waterfalls carry the hop: some traced request
+        # took >= 2 attempts and names retry_hop its slowest stage
+        events = read_events(wedged["run_dir"])
+        waterfalls = [
+            e for e in events
+            if e["kind"] == "rtrace" and e.get("phase") == "request"
+        ]
+        assert waterfalls
+        assert any(w.get("attempts", 0) >= 2 for w in waterfalls)
+        assert any(
+            w.get("slowest_stage") == "retry_hop"
+            for w in waterfalls
+        )
+        # the stats pump marked the wedged host's window stale (its
+        # bounded-timeout scrape kept failing) without stalling the
+        # pump — the fleet stats events carry the staleness live
+        windows = [
+            e.get("host_windows")
+            for e in events
+            if e["kind"] == "fleet" and e.get("phase") == "stats"
+            and e.get("host_windows") is not None
+        ]
+        assert windows
+        h0_rows = [
+            w["hosts"]["h0"] for w in windows
+            if "h0" in (w.get("hosts") or {})
+        ]
+        assert any(r["failures"] > 0 for r in h0_rows)
+        assert any(r["stale"] for r in h0_rows)
+        # summarize renders the fleet-trace section from the run dir
+        report, summary = summarize_run(wedged["run_dir"])
+        assert "fleet trace:" in report
+        assert summary["serving"]["verdict"]["fleet_attribution"][
+            "retry_hop_share"] > 0.0
+        wedged_vp = os.path.join(wedged["run_dir"], "verdict.json")
+
+        # ---- the compare gate -------------------------------------
+        # the clean baseline measured share 0.0, so ANY retry-hop
+        # time regresses regardless of how wide --tol-rel is opened
+        result = compare_runs(
+            [clean_vp, wedged_vp], tol_rel=5.0
+        )
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert rows["serve_fleet_retry_hop_share"]["verdict"] == (
+            "regression"
+        )
+        assert result["verdict"] == "regression"
+        assert cli_main(
+            ["compare", clean_vp, wedged_vp,
+             "--tol-rel", "5.0", "--json"]
         ) == 3
 
 

@@ -479,7 +479,7 @@ class TestVerdictV2:
             scenario="flash_crowd", rate=100.0, seed=0,
             slo_p99_ms=10.0,
         )
-        assert v["serve_verdict"] == 6
+        assert v["serve_verdict"] == 7
         assert v["scenario"] == "flash_crowd"
         # aggregate identity
         assert v["requests_submitted"] == 10
@@ -819,3 +819,116 @@ class TestServeHttpEndToEnd:
         )
         assert v["requests_failed"] == 0
         assert v["drained_clean"] and not v["preempted"]
+
+
+# ---------------------------------------------------------------------------
+# inbound x-rtrace trace-context hardening (PR 16): a non-fleet
+# client poking the trace header — malformed, oversized, duplicated —
+# must be IGNORED (fresh local trace), never a 500, never a crash
+# ---------------------------------------------------------------------------
+
+
+def _wait_traced(tracer, n=1, timeout=5.0):
+    """The local trace finishes AFTER the response flush — poll
+    briefly instead of racing the server's last stamp."""
+    deadline = time.time() + timeout
+    while tracer.finished < n and time.time() < deadline:
+        time.sleep(0.005)
+    assert tracer.finished == n
+
+
+class TestTraceContextHardening:
+    def _traced_fe(self, http_frontend):
+        from bdbnn_tpu.obs.rtrace import RequestTracer
+
+        tracer = RequestTracer(seed=0, sample_every=10**9)
+        fe = http_frontend(
+            lambda batch: list(batch), tracer=tracer,
+        )
+        return fe, tracer
+
+    def _predict_with(self, fe, rtrace_value):
+        return _request(
+            fe, "POST", "/v1/predict",
+            headers={"x-priority": "0", "x-tenant": "tenant-a",
+                     "x-rtrace": rtrace_value},
+            body=b"[1]",
+        )
+
+    @pytest.mark.parametrize("bad", [
+        "garbage",
+        "v=1;id=not-hex;seq=0;p=0",
+        "v=9;id=0123456789abcdef;seq=0;p=0",
+        "v=1;id=0123456789abcdef;seq=-3;p=0",
+        "v=1;id=0123456789abcdef;seq=0;p=0;tn=sp ace",
+        "v=1;id=0123456789abcdef;seq=0;p=0;" + "x" * 400,  # oversized
+        "\x00\x01\x02binary",
+    ])
+    def test_malformed_header_means_fresh_local_trace(
+        self, http_frontend, bad
+    ):
+        fe, tracer = self._traced_fe(http_frontend)
+        status, resp_headers, payload = self._predict_with(fe, bad)
+        # answered normally — and WITHOUT a stage header (that reply
+        # is the fleet stitching contract; a garbage context gets a
+        # fresh local trace instead, which has nothing to echo)
+        assert status == 200, payload
+        assert "x-rtrace-stages" not in resp_headers
+        _wait_traced(tracer)
+        # and the server is still alive for the next client
+        status, _, _ = _request(fe, "GET", "/healthz")
+        assert status == 200
+
+    def test_duplicate_header_is_ignored(self, http_frontend):
+        fe, tracer = self._traced_fe(http_frontend)
+        ctx = "v=1;id=0123456789abcdef;seq=0;p=0"
+        body = b"[1]"
+        # two x-rtrace lines on the wire: which hop minted it? —
+        # ambiguous, so the front end must fall back to a local trace
+        with socket.create_connection(
+            (fe.host, fe.port), timeout=10.0
+        ) as s:
+            head = (
+                "POST /v1/predict HTTP/1.1\r\nhost: t\r\n"
+                "x-priority: 0\r\nx-tenant: tenant-a\r\n"
+                f"x-rtrace: {ctx}\r\n"
+                f"x-rtrace: {ctx}\r\n"
+                f"content-length: {len(body)}\r\n"
+                "connection: close\r\n\r\n"
+            )
+            s.sendall(head.encode("latin-1") + body)
+            rfile = s.makefile("rb")
+            status = int(rfile.readline().split()[1])
+            resp_headers = {}
+            while True:
+                h = rfile.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = h.decode("latin-1").partition(":")
+                resp_headers[name.strip().lower()] = value.strip()
+        assert status == 200
+        assert "x-rtrace-stages" not in resp_headers
+        _wait_traced(tracer)
+
+    def test_valid_context_is_adopted_and_stages_echoed(
+        self, http_frontend
+    ):
+        from bdbnn_tpu.obs.rtrace import parse_stage_header
+
+        fe, tracer = self._traced_fe(http_frontend)
+        status, resp_headers, payload = self._predict_with(
+            fe, "v=1;id=0123456789abcdef;seq=7;p=0;tn=tenant-a",
+        )
+        assert status == 200, payload
+        parsed = parse_stage_header(resp_headers["x-rtrace-stages"])
+        assert parsed is not None
+        # the backend continues the SAME trace: the echoed id is the
+        # router's, and the header's stage sum equals its own total
+        # EXACTLY (the pre-write gap is folded into respond, so the
+        # only unattributed time is the final socket write — which
+        # lands in the router's network stage by construction)
+        assert parsed["id"] == "0123456789abcdef"
+        assert sum(parsed["stages"].values()) == pytest.approx(
+            parsed["total_ms"], abs=0.005,
+        )
+        _wait_traced(tracer)

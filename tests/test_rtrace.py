@@ -596,3 +596,429 @@ class TestTracingOverhead:
             f"{overhead:.2%} of the {per_request_s * 1e3:.2f}ms "
             "bench per-request wall — over the 2% budget"
         )
+
+
+# ---------------------------------------------------------------------------
+# Fleet tracing (PR 16): wire format, cross-host stitching, the fleet
+# metrics plane and the v7 fleet_attribution consumers
+# ---------------------------------------------------------------------------
+
+
+from bdbnn_tpu.obs.rtrace import (  # noqa: E402
+    FLEET_STAGES,
+    FleetTracer,
+    HostStatsWindows,
+    encode_stage_header,
+    encode_trace_context,
+    mint_trace_id,
+    parse_stage_header,
+    parse_trace_context,
+)
+
+
+class TestFleetWireFormat:
+    def test_trace_context_round_trip(self):
+        ctx = encode_trace_context("0123456789abcdef", 42, 2, "tenant-a")
+        parsed = parse_trace_context(ctx)
+        assert parsed == {
+            "id": "0123456789abcdef", "seq": 42,
+            "priority": 2, "tenant": "tenant-a",
+        }
+
+    def test_trace_context_round_trip_without_tenant(self):
+        ctx = encode_trace_context("f" * 16, 0, 0, None)
+        assert ";tn=" not in ctx
+        parsed = parse_trace_context(ctx)
+        assert parsed["tenant"] is None
+
+    def test_encode_omits_non_token_tenant(self):
+        # a tenant name that is not a safe header token is DROPPED at
+        # encode time, never smuggled onto the wire
+        ctx = encode_trace_context("a" * 16, 1, 1, "bad tenant;x=1")
+        assert ";tn=" not in ctx
+        assert parse_trace_context(ctx) is not None
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        "garbage",
+        "v=2;id=0123456789abcdef;seq=0;p=0",        # wrong version
+        "id=0123456789abcdef;seq=0;p=0",            # no version
+        "v=1;id=0123456789ABCDEF;seq=0;p=0",        # uppercase hex
+        "v=1;id=0123;seq=0;p=0",                    # short id
+        "v=1;id=0123456789abcdef;seq=-1;p=0",       # negative seq
+        "v=1;id=0123456789abcdef;seq=x;p=0",        # non-int seq
+        "v=1;id=0123456789abcdef;seq=0;p=64",       # priority too big
+        "v=1;id=0123456789abcdef;seq=0;p=0;tn=a b",  # bad tenant
+        "v=1;id=0123456789abcdef;id=0123456789abcdef;seq=0;p=0",  # dup
+        "v=1;;id=0123456789abcdef;seq=0;p=0",       # empty field
+        "v=1;id=0123456789abcdef;seq=0;p=0;" + "x" * 300,  # oversized
+    ])
+    def test_malformed_trace_context_is_rejected(self, bad):
+        assert parse_trace_context(bad) is None
+
+    def test_stage_header_round_trip(self):
+        hdr = encode_stage_header(
+            "0123456789abcdef", 12.5,
+            {"read": 0.25, "compute": 10.0, "respond": 2.25},
+        )
+        parsed = parse_stage_header(hdr)
+        assert parsed["id"] == "0123456789abcdef"
+        assert parsed["total_ms"] == 12.5
+        assert parsed["stages"] == {
+            "read": 0.25, "compute": 10.0, "respond": 2.25,
+        }
+
+    def test_stage_header_encode_drops_nonfinite_and_negative(self):
+        hdr = encode_stage_header(
+            "a" * 16, 5.0,
+            {"read": float("nan"), "compute": 5.0, "respond": -1.0},
+        )
+        parsed = parse_stage_header(hdr)
+        assert parsed["stages"] == {"compute": 5.0}
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        "garbage",
+        "v=1;id=0123456789abcdef;total=nan;read=1.0",
+        "v=1;id=0123456789abcdef;total=-1.0;read=1.0",
+        "v=1;id=0123456789abcdef;total=5.0;bogus_stage=1.0",
+        "v=1;id=0123456789abcdef;total=5.0;read=inf",
+        "v=1;id=0123456789abcdef;total=5.0;read=-1.0",
+        "v=1;id=zzzz;total=5.0;read=1.0",
+        "v=1;id=0123456789abcdef;total=5.0;read=1.0;" + "y" * 1100,
+    ])
+    def test_malformed_stage_header_is_rejected(self, bad):
+        assert parse_stage_header(bad) is None
+
+    def test_mint_trace_id_is_deterministic_and_distinct(self):
+        a = [mint_trace_id(7, i) for i in range(64)]
+        b = [mint_trace_id(7, i) for i in range(64)]
+        assert a == b
+        assert len(set(a)) == 64
+        assert all(len(t) == 16 for t in a)
+        assert parse_trace_context(
+            encode_trace_context(a[0], 0, 0, None)
+        ) is not None
+        assert mint_trace_id(8, 0) != mint_trace_id(7, 0)
+
+
+def _fleet_finish_exact(
+    tracer, priority, router_ms, backend_ms, *,
+    host="h0", network_ms=1.0, attempts=1, stitch=True,
+):
+    """One synthetic proxied request whose cursor is pinned so the
+    cross-hop identity holds EXACTLY: router stages + network +
+    backend stage sum == e2e (these tests are about the rollups and
+    the stitch bookkeeping, not the clock)."""
+    tr = tracer.begin(priority)
+    for stage, ms in router_ms.items():
+        tr.add(stage, ms)
+    backend_total = sum(backend_ms.values())
+    hdr = encode_stage_header(
+        tr.trace_id if stitch else "0" * 16, backend_total, backend_ms,
+    )
+    tr.attempts = attempts
+    tracer.stitch(tr, backend_total + network_ms, hdr, host)
+    total = sum(tr.stages.values()) + (
+        backend_total if tr.backend is not None else 0.0
+    )
+    tr._last = tr.t0 + total / 1000.0
+    tracer.finish(tr)
+    return tr
+
+
+class TestFleetTracerStitching:
+    BACKEND = {"read": 0.5, "queue": 1.0, "compute": 6.0, "respond": 0.5}
+
+    def test_matching_header_stitches_and_network_is_residual(self):
+        tracer = FleetTracer(seed=0, sample_every=1)
+        tr = _fleet_finish_exact(
+            tracer, 0, {"probe_wait": 0.2, "pick": 0.1, "connect": 0.7},
+            self.BACKEND, network_ms=2.5,
+        )
+        assert tr.backend == self.BACKEND
+        assert tr.backend_total_ms == sum(self.BACKEND.values())
+        # network = exchange wall - the backend's self-reported span:
+        # two DURATIONS, no cross-clock subtraction anywhere
+        assert tr.stages["network"] == pytest.approx(2.5, abs=1e-6)
+        st = tracer.stats()
+        assert st["stitched"] == 1 and st["unstitched"] == 0
+
+    def test_mismatched_id_falls_back_to_unstitched(self):
+        tracer = FleetTracer(seed=0, sample_every=1)
+        tr = _fleet_finish_exact(
+            tracer, 0, {"pick": 0.1}, self.BACKEND,
+            network_ms=2.5, stitch=False,
+        )
+        assert tr.backend is None
+        # the WHOLE exchange is charged to network — honest "we don't
+        # know where the time went inside the host"
+        assert tr.stages["network"] == pytest.approx(
+            sum(self.BACKEND.values()) + 2.5, abs=1e-6,
+        )
+        st = tracer.stats()
+        assert st["stitched"] == 0 and st["unstitched"] == 1
+
+    def test_reconciliation_holds_and_counts_violations(self):
+        tracer = FleetTracer(seed=0, sample_every=16)
+        for _ in range(20):
+            _fleet_finish_exact(
+                tracer, 0, {"pick": 0.1, "connect": 0.5}, self.BACKEND,
+            )
+        att = tracer.attribution()
+        recon = att["reconciliation"]
+        assert recon["requests"] == 20
+        assert recon["violations"] == 0
+        assert recon["ok"] is True
+        # now a torn request: 20ms of stage claims against a 1ms e2e
+        tr = tracer.begin(0)
+        tr.add("network", 20.0)
+        tr._last = tr.t0 + 0.001
+        tracer.finish(tr)
+        recon = tracer.attribution()["reconciliation"]
+        assert recon["violations"] == 1
+        assert recon["ok"] is False
+
+    def test_retry_hop_share_is_cumulative_over_e2e(self):
+        tracer = FleetTracer(seed=0, sample_every=16)
+        # 10 clean requests of 10ms, then 10 that burned a 10ms retry
+        # hop on top of the same backend work: share = 100/300
+        for _ in range(10):
+            _fleet_finish_exact(
+                tracer, 0, {"pick": 1.0}, {"compute": 8.0},
+                network_ms=1.0,
+            )
+        for _ in range(10):
+            _fleet_finish_exact(
+                tracer, 0, {"pick": 1.0, "retry_hop": 10.0},
+                {"compute": 8.0}, network_ms=1.0, attempts=2,
+            )
+        st = tracer.stats()
+        assert st["retry_hop_share"] == pytest.approx(0.3333, abs=1e-3)
+        att = tracer.attribution()
+        assert att["retry_hop_share"] == pytest.approx(
+            0.3333, abs=1e-3,
+        )
+        assert att["per_priority"]["0"]["retry_hop_share"] == (
+            att["retry_hop_share"]
+        )
+
+    def test_clean_run_share_is_zero_not_none(self):
+        # THE compare-gate precondition: a clean baseline publishes
+        # 0.0 (a measured zero), so ANY wedged increase is a
+        # regression under rel tolerance — never a silent None-skip
+        tracer = FleetTracer(seed=0, sample_every=16)
+        _fleet_finish_exact(tracer, 0, {"pick": 1.0}, {"compute": 8.0})
+        assert tracer.stats()["retry_hop_share"] == 0.0
+        assert tracer.attribution()["retry_hop_share"] == 0.0
+
+    def test_host_stage_spread_needs_two_hosts(self):
+        tracer = FleetTracer(seed=0, sample_every=16)
+        for _ in range(5):
+            _fleet_finish_exact(
+                tracer, 0, {"pick": 0.1}, {"compute": 5.0}, host="h0",
+            )
+        att = tracer.attribution()
+        assert att["host_stage_spread_max"] is None
+        for _ in range(5):
+            _fleet_finish_exact(
+                tracer, 0, {"pick": 0.1}, {"compute": 10.0}, host="h1",
+            )
+        att = tracer.attribution()
+        assert att["host_stage_spread"]["compute"] == pytest.approx(
+            2.0, abs=0.01,
+        )
+        assert att["host_stage_spread_max"] == pytest.approx(
+            2.0, abs=0.01,
+        )
+        assert att["per_host"]["h0"]["requests"] == 5
+        assert att["per_host"]["h1"]["requests"] == 5
+
+    def test_tail_exemplars_name_host_and_stage(self):
+        tracer = FleetTracer(seed=0, sample_every=10**9, tail_k=2)
+        _fleet_finish_exact(
+            tracer, 0, {"pick": 0.1}, {"compute": 50.0}, host="h1",
+        )
+        att = tracer.attribution()
+        wf = att["tail"]["0"][0]
+        assert wf["host"] == "h1"
+        assert wf["slowest_stage"] == "backend.compute"
+        assert wf["trace"] == wf["trace"].lower()
+        assert len(wf["trace"]) == 16
+        assert list(wf["stages"]) == [
+            s for s in FLEET_STAGES if s in wf["stages"]
+        ]
+
+    def test_stats_and_attribution_are_strict_json_safe(self):
+        from bdbnn_tpu.obs.events import jsonsafe
+
+        tracer = FleetTracer(seed=0, sample_every=1)
+        _fleet_finish_exact(tracer, 1, {"pick": 0.1}, {"compute": 5.0})
+        json.dumps(jsonsafe(tracer.stats()), allow_nan=False)
+        json.dumps(jsonsafe(tracer.attribution()), allow_nan=False)
+
+
+class TestHostStatsWindows:
+    def _block(self, compute_p99=5.0):
+        return {
+            "stage_p99_ms": {"compute": compute_p99, "queue": 1.0},
+            "e2e_p99_ms_by_priority": {"0": compute_p99 + 1.0},
+            "queue_share": 0.2,
+        }
+
+    def test_record_rolls_windows_and_merges(self):
+        w = HostStatsWindows(window=8, stale_after=3)
+        w.record("h0", self._block(5.0))
+        w.record("h1", self._block(9.0))
+        snap = w.snapshot()
+        assert snap["hosts_fresh"] == 2 and snap["hosts_stale"] == 0
+        assert snap["hosts"]["h0"]["stage_p99_ms"]["compute"] == 5.0
+        assert snap["merged"]["stage_p99_ms"]["compute"] == 9.0
+        assert snap["merged"]["e2e_p99_ms_by_priority"]["0"] == 10.0
+
+    def test_stale_after_consecutive_failures_and_excluded(self):
+        w = HostStatsWindows(window=8, stale_after=2)
+        w.record("h0", self._block(5.0))
+        w.record("wedged", self._block(50.0))
+        w.record_failure("wedged")
+        assert w.snapshot()["hosts"]["wedged"]["stale"] is False
+        w.record_failure("wedged")
+        snap = w.snapshot()
+        assert snap["hosts"]["wedged"]["stale"] is True
+        assert snap["hosts"]["wedged"]["fail_streak"] == 2
+        assert snap["hosts_stale"] == 1
+        # the wedged host's FROZEN window is out of the merged view —
+        # an autoscaler reading `merged` never acts on its numbers
+        assert snap["merged"]["stage_p99_ms"]["compute"] == 5.0
+
+    def test_success_resets_the_streak(self):
+        w = HostStatsWindows(window=8, stale_after=2)
+        w.record_failure("h0")
+        w.record("h0", self._block())
+        w.record_failure("h0")
+        snap = w.snapshot()
+        assert snap["hosts"]["h0"]["stale"] is False
+        assert snap["hosts"]["h0"]["fail_streak"] == 1
+        assert snap["hosts"]["h0"]["failures"] == 2
+        assert snap["hosts"]["h0"]["scrapes"] == 1
+
+    def test_malformed_scrape_payload_is_ignored_not_fatal(self):
+        w = HostStatsWindows(window=8, stale_after=3)
+        w.record("h0", {"stage_p99_ms": {"compute": float("nan"),
+                                         "queue": "bogus"},
+                        "e2e_p99_ms_by_priority": None})
+        snap = w.snapshot()
+        # nothing numeric survived: every stage window is still empty
+        assert all(
+            v is None
+            for v in snap["hosts"]["h0"]["stage_p99_ms"].values()
+        )
+        json.dumps(snap, allow_nan=False)
+
+
+class TestConsumersRenderFleetAttribution:
+    def _fleet_run_dir(self, tmp_path, *, wedged=False):
+        """A serve-fleet-shaped run dir: fleet start/stats events
+        carrying the metrics plane (router windows + scraped host
+        windows, one stale when wedged) and a v7 verdict with the
+        fleet_attribution block."""
+        from bdbnn_tpu.obs.events import EventWriter
+        from bdbnn_tpu.serve.fleet import fleet_slo_verdict
+
+        tracer = FleetTracer(seed=0, sample_every=1, tail_k=2)
+        for i in range(10):
+            _fleet_finish_exact(
+                tracer, 0,
+                {"probe_wait": 0.1, "pick": 0.1, "connect": 0.4,
+                 **({"retry_hop": 30.0} if wedged and i % 2 else {})},
+                {"read": 0.5, "queue": 1.0, "compute": 6.0,
+                 "respond": 0.5},
+                host="h%d" % (i % 2), network_ms=1.5,
+                attempts=2 if wedged and i % 2 else 1,
+            )
+        scrape = HostStatsWindows(window=8, stale_after=2)
+        scrape.record("h0", {"stage_p99_ms": {"compute": 6.0},
+                             "e2e_p99_ms_by_priority": {"0": 8.0}})
+        if wedged:
+            scrape.record_failure("h1")
+            scrape.record_failure("h1")
+        else:
+            scrape.record("h1", {"stage_p99_ms": {"compute": 6.5},
+                                 "e2e_p99_ms_by_priority": {"0": 8.5}})
+        run_dir = tmp_path / ("wedged" if wedged else "clean")
+        ev = EventWriter(str(run_dir))
+        ev.emit("fleet", phase="start", host="127.0.0.1", port=9000,
+                hosts=["127.0.0.1:9100", "127.0.0.1:9101"],
+                priorities=1, scenario="steady")
+        ev.emit("fleet", phase="stats", role="fleet-router",
+                draining=False, hosts_total=2, hosts_ready=2,
+                inflight=0, unrouteable=0, router_shed_draining=0,
+                hosts={}, swap=None, rtrace=tracer.stats(),
+                host_windows=scrape.snapshot())
+        lats = sorted(
+            tr_ms for tr_ms in
+            [10.0] * 5 + ([40.0] * 5 if wedged else [10.0] * 5)
+        )
+        counts = {
+            "submitted": 10, "completed": 10, "failed": 0,
+            "rejected": 0, "shed_draining": 0, "shed_over_quota": 0,
+            "shed_queue_full": 0, "shed_unavailable": 0,
+        }
+        v = fleet_slo_verdict(
+            {"wall_s": 1.0, "latencies_ms_by_priority": [lats],
+             "counts_by_priority": [counts]},
+            {"n_hosts": 2, "hosts": {}, "submitted": 10,
+             "completed_total": 10, "relayed_total": 0,
+             "router_unrouteable": 0, "router_shed_draining": 0,
+             "retries_total": 5 if wedged else 0,
+             "retry_rate": 0.5 if wedged else 0.0,
+             "host_p99_spread": 1.0, "dropped": 0,
+             "ledger_consistent": True, "swap": None},
+            scenario="steady", rate=100.0, seed=0,
+            fleet_attribution=tracer.attribution(),
+        )
+        ev.emit("serve", phase="verdict", **v)
+        ev.close()
+        return str(run_dir), v
+
+    def test_watch_renders_fleet_waterfall_and_stale_host(
+        self, tmp_path
+    ):
+        from bdbnn_tpu.obs.events import read_events
+        from bdbnn_tpu.obs.watch import render_status
+
+        run_dir, _ = self._fleet_run_dir(tmp_path, wedged=True)
+        events = read_events(run_dir)
+        live = render_status(
+            [e for e in events
+             if not (e.get("kind") == "serve"
+                     and e.get("phase") == "verdict")]
+        )
+        # the live fleet waterfall + the scraped per-host table, with
+        # the wedged host loudly STALE (never rendered as live data)
+        assert "trace: fleet p99/stage ms" in live
+        assert "retry_hop" in live
+        assert "scrape: 1 fresh / 1 stale" in live
+        assert "STALE" in live
+        final = render_status(events)
+        assert "fleet trace: p99/stage ms" in final
+        assert "slowest p0" in final
+        assert "CROSS-HOP RECONCILIATION BROKEN" not in final
+
+    def test_summarize_fleet_attribution_section(self, tmp_path):
+        from bdbnn_tpu.obs.summarize import summarize_run
+
+        run_dir, v = self._fleet_run_dir(tmp_path, wedged=False)
+        assert v["serve_verdict"] == 7
+        text, summary = summarize_run(run_dir)
+        fat = summary["serving"]["verdict"]["fleet_attribution"]
+        assert fat["requests"] == 10
+        assert fat["reconciliation"]["ok"] is True
+        assert "fleet trace: 10 requests traced" in text
+        assert "router p99/stage ms" in text
+        assert "backend p99/stage ms" in text
+        assert "per-host backend stage p99" in text
+        assert "slowest p0" in text
+        json.dumps(summary, allow_nan=False)
