@@ -1,30 +1,32 @@
-"""``verdict-coherence``: compare's serve-metric namespace cannot drift.
+"""``verdict-coherence``: compare's metric namespaces cannot drift.
 
 The literal-drift class PR 9 fixed ad hoc: ``obs/compare.py`` judges
-the serving SLO through string keys that must agree across FOUR
-places — the ``METRIC_SPECS`` judgment table, the ``_serve_metrics``
-flattener that produces those keys from a verdict, the
-verdict-PRODUCING sites (serve/loadgen.py, serve/http.py,
-serve/fleet.py) that emit
-the source fields the flattener reads, and the checked-in golden
-fixture (``tests/fixtures/compare/expected_verdict.json``) that pins
-the metric skeleton. A key renamed in any one of them silently turns
-a CI gate into a no-op (the metric lands ``None`` on both sides and
-``_judge`` skips it). This checker cross-references all four:
+each verdict family through string keys that must agree across FOUR
+places — the ``METRIC_SPECS`` judgment table, the per-family
+flattener (``_serve_metrics`` for serving SLO verdicts,
+``_perf_metrics`` for roofline perf verdicts) that produces those
+keys from a verdict, the verdict-PRODUCING sites that emit the
+source fields the flattener reads, and the checked-in golden fixture
+(``tests/fixtures/compare/expected_verdict.json``) that pins the
+metric skeleton. A key renamed in any one of them silently turns a
+CI gate into a no-op (the metric lands ``None`` on both sides and
+``_judge`` skips it). For every ``(flattener, prefix, producers)``
+row in ``FLATTENERS`` this checker cross-references all four:
 
-1. every ``serve_*`` metric in ``METRIC_SPECS`` is produced by
-   ``_serve_metrics``;
-2. every key ``_serve_metrics`` produces is judged in
-   ``METRIC_SPECS``;
-3. every produced ``serve_*`` key appears in the golden fixture's
+1. every ``<prefix>*`` metric in ``METRIC_SPECS`` is produced by the
+   flattener;
+2. every key the flattener produces is judged in ``METRIC_SPECS``;
+3. every produced ``<prefix>*`` key appears in the golden fixture's
    metric skeleton (when the fixture exists under the root);
-4. every top-level verdict field ``_serve_metrics`` reads
+4. every top-level verdict field the flattener reads
    (``verdict.get("...")``) appears as a string literal in at least
-   one verdict-producing site (when those files exist under the root).
+   one of that family's verdict-producing sites (when those files
+   exist under the root).
 
 All static: the flattener's produced-key set is recovered from its
-AST — constant subscripts, the ``_SERVE_METRIC_FIELDS`` table loop,
-and the ``f"serve_p99_ms_p{p}"`` per-priority loop over
+AST — constant subscripts, the ``(field, name)`` table loops
+(``_SERVE_METRIC_FIELDS`` / ``_PERF_METRIC_FIELDS``), and the
+``f"serve_p99_ms_p{p}"`` per-priority loop over
 ``range(_SERVE_PRIORITY_CLASSES)`` are all evaluated from literals.
 """
 
@@ -49,6 +51,14 @@ PRODUCER_FILES = (
     # v7 fleet_attribution block (whose serve_fleet_* gates
     # _serve_metrics reads) are produced here
     "bdbnn_tpu/serve/fleet.py",
+)
+
+# every judged verdict family: (flattener function in compare.py,
+# METRIC_SPECS key prefix owned by that family, producer files whose
+# literals must cover every verdict field the flattener reads)
+FLATTENERS = (
+    (FLATTENER, "serve_", PRODUCER_FILES),
+    ("_perf_metrics", "perf_", ("bdbnn_tpu/obs/roofline.py",)),
 )
 
 
@@ -212,94 +222,103 @@ def check_verdict_coherence(
                 src = f.read()
         except OSError:
             continue
-        if FLATTENER not in src or SPECS_NAME not in src:
+        if SPECS_NAME not in src or not any(
+            name in src for name, _, _ in FLATTENERS
+        ):
             continue
         try:
             tree = ast.parse(src, filename=path)
         except SyntaxError:
             continue  # reported by lock-discipline
-        fn = next(
-            (
-                n for n in tree.body
-                if isinstance(n, ast.FunctionDef) and n.name == FLATTENER
-            ),
-            None,
-        )
         specs = _module_literal(tree, SPECS_NAME)
-        if fn is None or not isinstance(specs, (tuple, list)):
+        if not isinstance(specs, (tuple, list)):
             continue
         rel = relpath(path, root)
-        judged = {
-            str(row[0])
-            for row in specs
-            if isinstance(row, (tuple, list)) and row
-            and str(row[0]).startswith("serve_")
-        }
-        produced, table_fields = _produced_keys(fn, tree)
-        produced_serve = {k for k in produced if k.startswith("serve_")}
-        for name in sorted(judged - produced_serve):
-            findings.append(Finding(
-                rel, fn.lineno, CHECKER_ID,
-                f"{SPECS_NAME} judges {name!r} but {FLATTENER} never "
-                "produces it (the gate silently skips)",
-            ))
-        for name in sorted(produced_serve - judged):
-            findings.append(Finding(
-                rel, fn.lineno, CHECKER_ID,
-                f"{FLATTENER} produces {name!r} but {SPECS_NAME} never "
-                "judges it (unjudged verdict metric)",
-            ))
-        # golden-fixture skeleton (when checked in under this root)
         golden = os.path.join(root, GOLDEN_FIXTURE)
-        if os.path.isfile(golden):
+        golden_keys: Set[str] = set()
+        golden_ok = os.path.isfile(golden)
+        if golden_ok:
             try:
                 with open(golden) as f:
                     doc = json.load(f)
-                keys: Set[str] = set()
-                _json_keys(doc, keys)
+                _json_keys(doc, golden_keys)
             except (OSError, ValueError):
-                keys = set()
+                golden_ok = False
                 findings.append(Finding(
                     GOLDEN_FIXTURE, 1, CHECKER_ID,
                     "golden fixture is unreadable / not valid JSON",
                 ))
-            for name in sorted(judged & produced_serve):
-                if keys and name not in keys:
-                    findings.append(Finding(
-                        GOLDEN_FIXTURE, 1, CHECKER_ID,
-                        f"serve metric {name!r} missing from the "
-                        "golden verdict fixture's metric skeleton",
-                    ))
-        # verdict-producing sites carry every source field literal
-        producers: List[Tuple[str, Set[str]]] = []
-        for prod_rel in PRODUCER_FILES:
-            p = os.path.join(root, prod_rel)
-            if not os.path.isfile(p):
+        for flattener, prefix, producer_files in FLATTENERS:
+            fn = next(
+                (
+                    n for n in tree.body
+                    if isinstance(n, ast.FunctionDef)
+                    and n.name == flattener
+                ),
+                None,
+            )
+            if fn is None:
                 continue
-            try:
-                with open(p) as f:
-                    ptree = ast.parse(f.read(), filename=p)
-            except (OSError, SyntaxError):
-                continue
-            producers.append((prod_rel, _string_literals(ptree)))
-        if producers:
-            all_literals: Set[str] = set()
-            for _, lits in producers:
-                all_literals |= lits
-            for field in sorted(_source_fields(fn) | table_fields):
-                if field not in all_literals:
-                    findings.append(Finding(
-                        rel, fn.lineno, CHECKER_ID,
-                        f"{FLATTENER} reads verdict field {field!r} "
-                        "but no verdict-producing site "
-                        f"({', '.join(p for p, _ in producers)}) "
-                        "mentions that literal",
-                    ))
+            judged = {
+                str(row[0])
+                for row in specs
+                if isinstance(row, (tuple, list)) and row
+                and str(row[0]).startswith(prefix)
+            }
+            produced, table_fields = _produced_keys(fn, tree)
+            produced_own = {k for k in produced if k.startswith(prefix)}
+            for name in sorted(judged - produced_own):
+                findings.append(Finding(
+                    rel, fn.lineno, CHECKER_ID,
+                    f"{SPECS_NAME} judges {name!r} but {flattener} "
+                    "never produces it (the gate silently skips)",
+                ))
+            for name in sorted(produced_own - judged):
+                findings.append(Finding(
+                    rel, fn.lineno, CHECKER_ID,
+                    f"{flattener} produces {name!r} but {SPECS_NAME} "
+                    "never judges it (unjudged verdict metric)",
+                ))
+            # golden-fixture skeleton (when checked in under this root)
+            if golden_ok and golden_keys:
+                for name in sorted(judged & produced_own):
+                    if name not in golden_keys:
+                        findings.append(Finding(
+                            GOLDEN_FIXTURE, 1, CHECKER_ID,
+                            f"metric {name!r} missing from the "
+                            "golden verdict fixture's metric skeleton",
+                        ))
+            # verdict-producing sites carry every source field literal
+            producers: List[Tuple[str, Set[str]]] = []
+            for prod_rel in producer_files:
+                p = os.path.join(root, prod_rel)
+                if not os.path.isfile(p):
+                    continue
+                try:
+                    with open(p) as f:
+                        ptree = ast.parse(f.read(), filename=p)
+                except (OSError, SyntaxError):
+                    continue
+                producers.append((prod_rel, _string_literals(ptree)))
+            if producers:
+                all_literals: Set[str] = set()
+                for _, lits in producers:
+                    all_literals |= lits
+                for field in sorted(_source_fields(fn) | table_fields):
+                    if field not in all_literals:
+                        findings.append(Finding(
+                            rel, fn.lineno, CHECKER_ID,
+                            f"{flattener} reads verdict field "
+                            f"{field!r} but no verdict-producing site "
+                            f"({', '.join(p for p, _ in producers)}) "
+                            "mentions that literal",
+                        ))
     return sorted(findings)
 
 
 __all__ = [
     "CHECKER_ID",
+    "FLATTENERS",
     "GOLDEN_FIXTURE",
     "PRODUCER_FILES",
     "check_verdict_coherence",

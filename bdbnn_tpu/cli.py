@@ -1652,6 +1652,90 @@ def registry_main(argv) -> int:
     return 0
 
 
+def perf_main(argv) -> int:
+    """``python -m bdbnn_tpu.cli perf ARTIFACT [flags]`` — the
+    performance observatory (obs/roofline.py): static per-layer
+    roofline over the artifact's arch (FLOPs, bytes per packing
+    regime, bound class against the device's ceilings) joined to a
+    measured bucket x packed-impl sweep with per-layer trace
+    attribution; prints the strict-JSON ``perf_verdict``, renders the
+    human roofline tables on stderr, and appends one line to the
+    log path's append-only ``PERF_LEDGER.jsonl``."""
+    import json
+
+    from bdbnn_tpu.configs.config import PerfConfig
+
+    ap = argparse.ArgumentParser(
+        prog="bdbnn_tpu.cli perf",
+        description="Per-layer roofline attribution over an export "
+        "artifact: predicted roof vs measured device ms per bucket "
+        "and packed impl, with a persisted perf ledger.",
+    )
+    ap.add_argument("artifact", help="export artifact dir")
+    ap.add_argument("--log-path", default="perf_log")
+    ap.add_argument(
+        "--buckets", type=int, nargs="+", default=[1, 8, 32],
+        help="engine batch-size buckets to sweep",
+    )
+    ap.add_argument(
+        "--impls", nargs="+", default=["dense", "unpack", "popcount"],
+        choices=["dense", "unpack", "popcount"],
+        help="packed_impl variants to measure (popcount on a bf16 "
+        "artifact is recorded as skipped)",
+    )
+    ap.add_argument(
+        "--iters", type=int, default=20,
+        help="measured steps per (impl, bucket) trace window",
+    )
+    ap.add_argument(
+        "--ceilings", default="",
+        help="JSON file overriding the hardware-ceilings table: one "
+        "row {peak_flops, hbm_gbs} used directly, or a "
+        "{device_kind: row} table merged over the built-in one",
+    )
+    ap.add_argument(
+        "--static-only", action="store_true",
+        help="cost model only: no engines, no compiles, no traces",
+    )
+    ap.add_argument(
+        "--tol-reconcile", type=float, default=0.5,
+        help="trace-vs-wall reconciliation tolerance as a fraction "
+        "of the wall (default 0.5)",
+    )
+    ap.add_argument(
+        "--out", default="",
+        help="also write the perf verdict JSON here",
+    )
+    ap.add_argument(
+        "--events-max-mb", type=float, default=256.0,
+        help="rotate the perf run's events.jsonl past this size in "
+        "MiB (default 256; 0 = unbounded)",
+    )
+    args = ap.parse_args(argv)
+
+    _force_jax_platforms()
+
+    from bdbnn_tpu.obs.roofline import render_perf, run_perf
+
+    cfg = PerfConfig(
+        artifact=args.artifact,
+        log_path=args.log_path,
+        buckets=tuple(args.buckets),
+        impls=tuple(args.impls),
+        iters=args.iters,
+        ceilings=args.ceilings,
+        static_only=args.static_only,
+        tol_reconcile=args.tol_reconcile,
+        out=args.out,
+        events_max_mb=args.events_max_mb,
+    ).validate()
+    result = run_perf(cfg)
+    print(json.dumps(result["verdict"], indent=2, sort_keys=True))
+    print(render_perf(result["verdict"]), file=sys.stderr)
+    print(f"[perf] run dir: {result['run_dir']}", file=sys.stderr)
+    return 0
+
+
 _SUBCOMMANDS = {
     "summarize": summarize_main,
     "watch": watch_main,
@@ -1663,6 +1747,7 @@ _SUBCOMMANDS = {
     "serve-fleet": serve_fleet_main,
     "registry": registry_main,
     "search": search_main,
+    "perf": perf_main,
     "check": check_main,
 }
 

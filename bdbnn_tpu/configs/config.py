@@ -392,6 +392,67 @@ class ServeBenchConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PerfConfig:
+    """Typed configuration of the ``perf`` CLI (obs/roofline.py).
+
+    Same resolve-once contract as ServeBenchConfig: the roofline sweep
+    validates its knobs before any backend exists, so a bad impl name
+    fails at the command line, not after the first engine compiled.
+    """
+
+    artifact: str  # export artifact dir (serve/export.py)
+    log_path: str = "perf_log"  # run dirs + PERF_LEDGER.jsonl land here
+    # engine batch-size buckets to sweep; each gets its own static
+    # cost-model table (batch changes intensity) and, per impl, its own
+    # traced timing window
+    buckets: Tuple[int, ...] = (1, 8, 32)
+    # packed_impl variants to measure: "dense" (reconstructed f32
+    # weights), "unpack" (1-bit resident, transient unpack -> XLA
+    # conv), "popcount" (XNOR-popcount dot). popcount on a bf16
+    # artifact is recorded as skipped, never an error — the sweep's
+    # other impls still land.
+    impls: Tuple[str, ...] = ("dense", "unpack", "popcount")
+    # measured steps per (impl, bucket) profiler window (one extra
+    # unmeasured warmup step runs outside the window)
+    iters: int = 20
+    # ceilings override: path to a JSON file — either one row
+    # {"peak_flops": ..., "hbm_gbs": ...} used directly, or a
+    # {device_kind: row} table merged over the built-in one
+    ceilings: str = ""
+    # static cost model only: no engines, no compiles, no traces
+    static_only: bool = False
+    # reconciliation tolerance: |trace device-op total - wall| / wall
+    # above this marks the bucket's reconciliation not-ok (CPU walls
+    # carry dispatch overhead the device-op sum doesn't, hence loose)
+    tol_reconcile: float = 0.5
+    out: str = ""  # also write the perf verdict JSON here
+    events_max_mb: float = 256.0
+
+    def validate(self) -> "PerfConfig":
+        if not self.artifact:
+            raise ValueError("perf needs an export artifact dir")
+        if not self.buckets or any(int(b) <= 0 for b in self.buckets):
+            raise ValueError(
+                f"--buckets must be positive ints, got {self.buckets!r}"
+            )
+        known = ("dense", "unpack", "popcount")
+        if not self.impls or any(i not in known for i in self.impls):
+            raise ValueError(
+                f"--impls must be a subset of {known}, got "
+                f"{self.impls!r}"
+            )
+        if len(set(self.impls)) != len(self.impls):
+            raise ValueError(f"duplicate impls: {self.impls!r}")
+        if self.iters < 1:
+            raise ValueError("--iters must be >= 1")
+        if self.tol_reconcile <= 0:
+            raise ValueError("--tol-reconcile must be > 0")
+        if self.events_max_mb < 0:
+            raise ValueError("--events-max-mb must be >= 0")
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeHttpConfig:
     """Typed configuration of the ``serve-http`` CLI (serve/http.py).
 

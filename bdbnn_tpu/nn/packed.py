@@ -81,6 +81,53 @@ def packed_impl(impl: str):
         set_packed_impl(prev)
 
 
+# ---------------------------------------------------------------------------
+# byte accounting (pure int math — no arrays, no tracing). These four
+# functions are the SINGLE source of truth for how many bytes each
+# representation of a binary conv costs:
+# engine.residency() and the roofline cost model (obs/roofline.py) both
+# call them, so the residency report and the per-layer HBM-byte columns
+# can never drift apart.
+# ---------------------------------------------------------------------------
+
+
+def dense_weight_bytes(shape) -> int:
+    """f32 dense footprint of a weight tensor: ``prod(shape) * 4``."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * 4
+
+
+def packed_weight_bytes(shape) -> int:
+    """XNOR-Net packed footprint of a binary conv weight: packbits sign
+    (1 bit/element, byte-rounded) + per-output-channel f32 alpha — the
+    exact bytes ``export.write_artifact`` stores and
+    ``load_artifact_packed`` keeps resident (``sign.nbytes +
+    alpha.nbytes``)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return (n + 7) // 8 + int(shape[-1]) * 4
+
+
+def packed_activation_bytes(n_elems: int) -> int:
+    """1-bit activation footprint: ``n_elems`` sign bits, byte-rounded.
+    The packed-activation roofline regime prices binary-conv INPUTS at
+    this — the end-to-end activation-packing item's target number."""
+    return (int(n_elems) + 7) // 8
+
+
+def popcount_word_bytes(kh: int, kw: int, c: int) -> int:
+    """Per-output-position uint32 working set of the popcount dot:
+    ``K = kh*kw*c`` patch lanes padded to a multiple of 32, packed into
+    words TWICE (xwords + maskwords — see :func:`popcount_binary_conv`),
+    4 bytes each."""
+    k = int(kh) * int(kw) * int(c)
+    nw = (k + 31) // 32
+    return 2 * nw * 4
+
+
 def unpack_sign_device(packed: Array, shape) -> Array:
     """Device twin of :func:`bdbnn_tpu.serve.export.unpack_sign`: ±1
     float32 of ``shape`` from a uint8 packbits payload. ``unpackbits``
@@ -216,10 +263,14 @@ def popcount_binary_conv(
 __all__ = [
     "PACKED_COLLECTION",
     "PACKED_IMPLS",
+    "dense_weight_bytes",
     "get_packed_impl",
+    "packed_activation_bytes",
     "packed_dense_weight",
     "packed_impl",
+    "packed_weight_bytes",
     "popcount_binary_conv",
+    "popcount_word_bytes",
     "set_packed_impl",
     "unpack_sign_device",
 ]

@@ -167,6 +167,24 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     # None, so they skip cleanly in both directions.
     ("search_best_top1", "higher", "acc"),
     ("search_time_to_common_acc_s", "lower", "rel"),
+    # v8 perf verdicts (obs/roofline.py): the performance
+    # observatory's flat aggregates — best/dense/packed step ms at the
+    # summary bucket (lower, --tol-rel), the mean per-layer roofline
+    # efficiency (higher — a drop means kernels moved AWAY from their
+    # roof even if walls held), and the attributed share of device
+    # time (higher — a drop means the trace join degraded and the
+    # per-layer gates below are seeing less of the step). On top of
+    # these STATIC keys, compare_runs judges every (layer, bucket,
+    # impl) ms the two perf sources share as a dynamic
+    # ``perf_ms[...]`` row under --tol-rel — the per-layer regression
+    # gate: a kernel swap that holds the aggregate while regressing
+    # one layer exits 3. Non-perf sources leave all of these None, so
+    # they skip cleanly in both directions.
+    ("perf_step_ms_best", "lower", "rel"),
+    ("perf_step_ms_dense", "lower", "rel"),
+    ("perf_step_ms_packed", "lower", "rel"),
+    ("perf_efficiency_mean", "higher", "rel"),
+    ("perf_attributed_share", "higher", "rel"),
 )
 
 # serve-verdict field -> compare metric name (flat v1 aggregates)
@@ -287,6 +305,30 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
             (swap.get("shed") or 0) + dropped + not_performed
         )
     return out
+
+# perf-verdict summary field -> compare metric name (obs/roofline.py
+# ``summary`` block; the table shape keeps the flattener AST-scannable
+# by analysis/verdictcheck.py)
+_PERF_METRIC_FIELDS: Tuple[Tuple[str, str], ...] = (
+    ("step_ms_best", "perf_step_ms_best"),
+    ("step_ms_dense", "perf_step_ms_dense"),
+    ("step_ms_packed", "perf_step_ms_packed"),
+    ("efficiency_mean", "perf_efficiency_mean"),
+    ("attributed_share", "perf_attributed_share"),
+)
+
+
+def _perf_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten one perf verdict (obs/roofline.py schema v1) into the
+    compare metric namespace — shared by the run-dir and artifact
+    extraction paths. A static-only run has no summary aggregates:
+    every key stays None (skipped), never a fabricated 0."""
+    summary = verdict.get("summary") or {}
+    out: Dict[str, Any] = {}
+    for field, name in _PERF_METRIC_FIELDS:
+        out[name] = summary.get(field)
+    return out
+
 
 def _search_metrics(leaderboard: Dict[str, Any]) -> Dict[str, Any]:
     """Flatten one recipe-search leaderboard (bdbnn_tpu/search/) into
@@ -423,21 +465,44 @@ def _extract_run_dir(path: str) -> Dict[str, Any]:
     )
     if search_verdict is not None:
         metrics.update(_search_metrics(search_verdict))
+    # a perf run dir (obs/roofline.py): the final `perf` verdict event
+    # embeds the full perf_verdict; alignment uses the recipe the
+    # verdict copied from the artifact's provenance (the PerfConfig
+    # manifest itself carries no arch/dataset)
+    perf_ev = next(
+        (
+            e for e in reversed(events)
+            if e.get("kind") == "perf" and e.get("phase") == "verdict"
+        ),
+        None,
+    )
+    perf_layers: Dict[str, Any] = {}
+    recipe = _recipe_from_config(cfg)
+    if perf_ev is not None:
+        pv = perf_ev.get("verdict") or {}
+        metrics.update(_perf_metrics(pv))
+        perf_layers = pv.get("perf_layers") or {}
+        pv_recipe = (pv.get("provenance") or {}).get("recipe")
+        if pv_recipe:
+            recipe = _recipe_from_config(pv_recipe)
     fmt = "run_dir"
     if serve_verdict is not None:
         fmt = "serve_run_dir"
     elif search_verdict is not None:
         fmt = "search_run_dir"
+    elif perf_ev is not None:
+        fmt = "perf_run_dir"
     return {
         "source": path,
         "format": fmt,
         "provenance": {
             "config_hash": manifest.get("config_hash"),
             "device_kind": manifest.get("device_kind"),
-            "recipe": _recipe_from_config(cfg),
+            "recipe": recipe,
         },
         "metrics": metrics,
         "acc_curve": acc_curve,
+        "perf_layers": perf_layers,
     }
 
 
@@ -478,6 +543,25 @@ def _extract_artifact(path: str) -> Dict[str, Any]:
             },
             "metrics": metrics,
             "acc_curve": [],
+        }
+    if "perf_verdict" in d:
+        # a roofline perf verdict (obs/roofline.py): aligned on the
+        # artifact provenance it embeds, judged on summary aggregates
+        # plus per-(layer, bucket, impl) device ms via perf_layers
+        prov = d.get("provenance") or {}
+        metrics = dict(_EMPTY_METRICS)
+        metrics.update(_perf_metrics(d))
+        return {
+            "source": path,
+            "format": "perf_verdict",
+            "provenance": {
+                "config_hash": prov.get("config_hash"),
+                "device_kind": prov.get("device_kind"),
+                "recipe": _recipe_from_config(prov.get("recipe") or {}),
+            },
+            "metrics": metrics,
+            "acc_curve": [],
+            "perf_layers": d.get("perf_layers") or {},
         }
     parsed = d.get("parsed")
     if isinstance(parsed, dict) and "metric" in parsed:
@@ -527,7 +611,8 @@ def _extract_artifact(path: str) -> Dict[str, Any]:
     raise ValueError(
         f"{path!r}: not a recognized artifact (want a BENCH_*.json "
         "'parsed' bench line, an ACCURACY_*.json with best_val_top1, "
-        "a serve-bench verdict.json, or a search leaderboard.json)"
+        "a serve-bench verdict.json, a search leaderboard.json, or a "
+        "perf_verdict.json)"
     )
 
 
@@ -639,6 +724,21 @@ def compare_runs(
                     c = cand["metrics"].get(name)
                 row = _judge(
                     name, direction, kind, b, c,
+                    tol_acc_pp=tol_acc_pp, tol_rel=tol_rel,
+                    tol_hbm=tol_hbm,
+                )
+                if row is not None:
+                    metrics.append(row)
+            # dynamic per-(layer, bucket, impl) device-ms rows from the
+            # perf observatory: a single layer can regress while every
+            # aggregate above stays flat, so each shared key gets its
+            # own lower-is-better relative gate
+            bl = base.get("perf_layers") or {}
+            cl = cand.get("perf_layers") or {}
+            for key in sorted(set(bl) & set(cl)):
+                row = _judge(
+                    f"perf_ms[{key}]", "lower", "rel",
+                    bl[key], cl[key],
                     tol_acc_pp=tol_acc_pp, tol_rel=tol_rel,
                     tol_hbm=tol_hbm,
                 )

@@ -220,6 +220,18 @@ The static analyzer adds one more:
   open finding records — so ``summarize`` can render the last static-
   analysis verdict alongside a run's telemetry
 
+The performance observatory (obs/roofline.py) adds one:
+
+- ``perf``        — one ``perf`` CLI run's lifecycle, disambiguated
+  by ``phase``: ``start`` (artifact, arch, buckets, impls, iters,
+  device kind), ``bucket`` (one (impl, bucket) traced timing window:
+  wall ms, attributed ms, whether the trace reconciled against the
+  wall) and ``verdict`` (the full strict-JSON ``perf_verdict`` —
+  per-layer roofline efficiency, bound classes, summary aggregates —
+  the same dict the run dir's ``perf_verdict.json`` and the
+  append-only ``PERF_LEDGER.jsonl`` persist; what ``compare`` judges
+  per-(layer, bucket, impl) and ``watch``/``summarize`` render)
+
 New kinds must be registered in :data:`KNOWN_KINDS` — the
 ``event-schema`` checker (bdbnn_tpu/analysis/eventschema.py, wrapped
 as a tier-1 test by ``tests/test_events_schema.py``) AST-scans every
@@ -282,6 +294,7 @@ KNOWN_KINDS = frozenset(
         "search",
         "trial",
         "analysis",
+        "perf",
     }
 )
 

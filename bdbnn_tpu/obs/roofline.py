@@ -1,0 +1,819 @@
+"""Kernel-grade performance observatory: per-layer roofline + perf CLI.
+
+The ROADMAP's two highest-value open items — the Pallas XNOR-popcount
+kernel and end-to-end packed activations (arXiv:1603.05279) — are
+blocked on measurement, not code: nothing could say, per conv layer and
+per batch bucket, whether the packed paths are memory-bound or
+compute-bound, or what the popcount lowering's ceiling actually is
+(arXiv:1911.04477's kernel tricks only pay off on memory-bound layers).
+This module is that instrument, in three parts:
+
+1. **Static cost model** (:func:`model_layer_table`,
+   :func:`layer_regimes`) — per-layer FLOPs and HBM bytes for any
+   registry arch, derived generically by walking the flax module tree
+   under ``jax.eval_shape`` (zero device work, zero FLOPs executed)
+   with ``nn.intercept_methods`` capturing each conv/dense call's
+   abstract in/out shapes. Bytes are priced under three regimes —
+   ``dense`` (f32 weights + f32 activations), ``packed_weight``
+   (XNOR-Net 1-bit weights + alpha, the engine's packed residency) and
+   ``packed_act`` (1-bit weights AND 1-bit binary-conv inputs, the
+   activation-packing target) — using the SAME byte hooks
+   (nn/packed.py) ``engine.residency()`` reports, so the cost model
+   and the residency ledger cannot drift. Each (layer, regime) gets an
+   arithmetic intensity, a memory/compute bound class against a
+   hardware-ceilings table, and a roof ms.
+
+2. **Measured side** (:func:`run_perf`) — sweeps ``InferenceEngine``
+   buckets x ``packed_impl`` variants (dense, unpack-dot, popcount —
+   and any future Pallas impl for free, it's one more engine ctor
+   flag), captures a profiler window per (impl, bucket) with
+   ``engine.trace_step``, joins per-layer device ms back to the model
+   via the compiled-HLO ``op_name`` metadata
+   (``obs.trace.hlo_op_scopes`` — the join that works on CPU, whose
+   trace events carry no ``tf_op``), and reports per-layer efficiency
+   (roof/achieved) plus a reconciliation of the trace's device-op sum
+   against the very ``time_step``-style wall it was captured under.
+
+3. **Perf ledger** — a strict-JSON ``perf_verdict`` (schema v1) in a
+   stamped run dir (manifest provenance + ``perf`` events) plus one
+   line appended to ``<log_path>/PERF_LEDGER.jsonl``; ``compare``
+   judges the flat aggregates AND every shared (layer, bucket, impl)
+   ms under ``--tol-rel`` (exit 3 on regression), so a kernel swap
+   that wins the aggregate while regressing one layer is caught.
+
+Module-level imports are stdlib-only (obs-package rule — ``summarize``
+and ``compare`` import siblings freely); jax/flax load inside the
+functions that need them.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from bdbnn_tpu.obs.trace import BF16_PEAK_TFLOPS
+
+PERF_SCHEMA_VERSION = 1
+PERF_VERDICT_NAME = "perf_verdict.json"
+PERF_LEDGER_NAME = "PERF_LEDGER.jsonl"
+BENCH_ARTIFACT_NAME = "BENCH_perf.json"
+
+# Published per-chip HBM bandwidths (GB/s), keyed like
+# trace.BF16_PEAK_TFLOPS on jax.devices()[0].device_kind. Sources:
+# Google Cloud TPU system-architecture docs (v2-v6e product pages).
+_HBM_GBS: Dict[str, float] = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,  # v5e
+    "TPU v5e": 819.0,
+    "TPU v5": 2765.0,      # v5p reports device_kind "TPU v5"
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,  # v6e (Trillium)
+    "TPU v6e": 1640.0,
+}
+
+# device_kind -> roofline ceilings. TPU rows reuse the SAME peak table
+# bench/profile/summarize already cite (obs/trace.py); the cpu row is a
+# deliberately conservative host-class stand-in so CPU-mesh perf runs
+# (CI, dev boxes) still classify and never divide by zero — real CPU
+# studies should pass --ceilings with the host's measured numbers.
+CEILINGS: Dict[str, Dict[str, Any]] = {
+    **{
+        kind: {
+            "peak_flops": tf * 1e12,
+            "hbm_gbs": _HBM_GBS[kind],
+            "source": "cloud TPU system-architecture docs",
+        }
+        for kind, tf in BF16_PEAK_TFLOPS.items()
+    },
+    "cpu": {
+        "peak_flops": 2.0e11,
+        "hbm_gbs": 50.0,
+        "source": "conservative host-class default; override --ceilings",
+    },
+}
+
+# packed_impl -> the byte regime whose roof it is judged against.
+# popcount maps to packed_act: the XNOR-popcount dot is the lowering
+# the packed-activation regime's roof describes (1-bit operands on
+# both sides) — its roof is the idealized ceiling arXiv:1911.04477's
+# tricks chase, so efficiency against it shows how far the current
+# im2col+pack lowering is from that ceiling.
+IMPL_REGIME: Dict[str, str] = {
+    "dense": "dense",
+    "unpack": "packed_weight",
+    "popcount": "packed_act",
+}
+
+
+# ---------------------------------------------------------------------------
+# ceilings + pure roofline math
+# ---------------------------------------------------------------------------
+
+
+def resolve_ceilings(
+    device_kind: str, override: Any = None
+) -> Dict[str, Any]:
+    """The ceilings row for ``device_kind``: exact key, else substring
+    match (``"TPU v5 lite"`` vs ``"TPU v5e"`` style aliases), else the
+    ``cpu`` fallback. ``override`` (a dict, or a path to a JSON file)
+    either IS a ceilings row (has ``peak_flops``/``hbm_gbs``) or is a
+    table merged over the built-in one before lookup."""
+    table = dict(CEILINGS)
+    if isinstance(override, str) and override:
+        with open(override) as f:
+            override = json.load(f)
+    if isinstance(override, dict):
+        if "peak_flops" in override or "hbm_gbs" in override:
+            row = {**table["cpu"], "source": "--ceilings", **override}
+            return _ceilings_row(device_kind, device_kind, row)
+        table.update(override)
+    kind = str(device_kind or "")
+    if kind in table:
+        return _ceilings_row(kind, kind, table[kind])
+    low = kind.lower()
+    for k in sorted(table):
+        kl = k.lower()
+        if kl != "cpu" and (kl in low or low in kl):
+            return _ceilings_row(kind, k, table[k])
+    return _ceilings_row(kind, "cpu", table["cpu"])
+
+
+def _ceilings_row(
+    device_kind: str, matched: str, row: Dict[str, Any]
+) -> Dict[str, Any]:
+    peak = float(row["peak_flops"])
+    bw = float(row["hbm_gbs"])
+    return {
+        "device_kind": device_kind,
+        "matched": matched,
+        "peak_flops": peak,
+        "hbm_gbs": bw,
+        "ridge_intensity": round(peak / (bw * 1e9), 3),
+        "source": row.get("source", "unknown"),
+    }
+
+
+def arithmetic_intensity(flops: float, nbytes: float) -> float:
+    """FLOPs per HBM byte — the roofline x-axis."""
+    return float(flops) / max(float(nbytes), 1.0)
+
+
+def ridge_intensity(ceilings: Dict[str, Any]) -> float:
+    """The intensity where the memory roof meets the compute roof:
+    ``peak_flops / hbm_bytes_per_s``. Below it a kernel is
+    bandwidth-limited no matter how good its compute schedule is."""
+    return float(ceilings["peak_flops"]) / (
+        float(ceilings["hbm_gbs"]) * 1e9
+    )
+
+
+def classify_bound(intensity: float, ceilings: Dict[str, Any]) -> str:
+    """``"compute"`` at or above the ridge, else ``"memory"`` — the
+    bound class that decides whether a popcount/Pallas compute trick
+    can pay off on a layer at all."""
+    return (
+        "compute" if float(intensity) >= ridge_intensity(ceilings)
+        else "memory"
+    )
+
+
+def roof_ms(
+    flops: float, nbytes: float, ceilings: Dict[str, Any]
+) -> float:
+    """Best-case ms for ``flops`` of work moving ``nbytes`` of HBM
+    traffic: ``max(compute time, memory time)`` — the classic roofline
+    bound, never zero-divided (ceilings are validated positive)."""
+    t_compute = float(flops) / float(ceilings["peak_flops"])
+    t_memory = float(nbytes) / (float(ceilings["hbm_gbs"]) * 1e9)
+    return max(t_compute, t_memory) * 1e3
+
+
+# ---------------------------------------------------------------------------
+# static per-layer cost model
+# ---------------------------------------------------------------------------
+
+
+def model_layer_table(
+    arch: str,
+    dataset: str,
+    batch: int,
+    *,
+    image_size: Optional[int] = None,
+    dtype: str = "float32",
+    twoblock: bool = False,
+) -> List[Dict[str, Any]]:
+    """One row per conv/dense call of ``arch`` at batch ``batch``:
+    shapes, FLOPs, and bytes under every packing regime — derived
+    GENERICALLY (any registry arch, present or future) by intercepting
+    the flax apply under ``jax.eval_shape``, so no weights exist and
+    nothing executes.
+
+    Binary-vs-float conv classification reads the variable tree the
+    modules themselves declared: binary convs param ``float_weight``
+    (nn/layers.py ``_BinaryConvBase``), float convs param ``weight``,
+    ``nn.Dense`` param ``kernel``. Rows come back in call order; a
+    weight-shared module recorded once (first call)."""
+    import flax.linen as fnn
+    import jax
+    import numpy as np
+
+    from bdbnn_tpu.models.registry import create_model
+    from bdbnn_tpu.nn.packed import (
+        dense_weight_bytes,
+        packed_activation_bytes,
+        packed_weight_bytes,
+        popcount_word_bytes,
+    )
+
+    model = create_model(
+        arch, dataset, dtype=dtype, twoblock=bool(twoblock)
+    )
+    size = (
+        int(image_size)
+        if image_size
+        else (224 if dataset == "imagenet" else 32)
+    )
+    n = int(batch)
+    x = jax.ShapeDtypeStruct((n, size, size, 3), np.float32)
+    variables = jax.eval_shape(model.init, jax.random.PRNGKey(0), x)
+    params = variables.get("params", {})
+    act_bpe = 2 if str(dtype) == "bfloat16" else 4
+
+    rows: List[Dict[str, Any]] = []
+    seen: set = set()
+
+    def _params_node(path: Tuple[str, ...]) -> Dict[str, Any]:
+        node: Any = params
+        for p in path:
+            try:
+                node = node[p]
+            except (KeyError, TypeError):
+                return {}
+        return node if hasattr(node, "keys") else {}
+
+    def _record(mod, in_shape, out_shape) -> None:
+        path = tuple(mod.path)
+        if not path or path in seen:
+            return
+        seen.add(path)
+        node = _params_node(path)
+        n_in = 1
+        for d in in_shape:
+            n_in *= int(d)
+        n_out = 1
+        for d in out_shape:
+            n_out *= int(d)
+        if isinstance(mod, fnn.Dense):
+            kshape = tuple(int(d) for d in node["kernel"].shape)
+            row = {
+                "name": ".".join(path),
+                "scope": "/".join(path),
+                "kind": "dense",
+                "batch": n,
+                "in_shape": [int(d) for d in in_shape],
+                "out_shape": [int(d) for d in out_shape],
+                "kernel": None,
+                "strides": None,
+                "flops": 2 * n_out * kshape[0],
+                "weight_dense_bytes": dense_weight_bytes(kshape),
+                "weight_packed_bytes": dense_weight_bytes(kshape),
+                "act_in_bytes": n_in * act_bpe,
+                "act_out_bytes": n_out * act_bpe,
+                "act_in_packed_bytes": n_in * act_bpe,
+                "popcount_word_bytes": None,
+            }
+        else:
+            binary = "float_weight" in node
+            w = node["float_weight" if binary else "weight"]
+            kh, kw, ci, co = (int(d) for d in w.shape)
+            row = {
+                "name": ".".join(path),
+                "scope": "/".join(path),
+                "kind": "binary" if binary else "float",
+                "batch": n,
+                "in_shape": [int(d) for d in in_shape],
+                "out_shape": [int(d) for d in out_shape],
+                "kernel": [kh, kw],
+                "strides": [int(s) for s in mod.strides],
+                # 2 * output elements * kernel volume (MAC = 2 FLOPs)
+                "flops": 2 * n_out * kh * kw * ci,
+                "weight_dense_bytes": dense_weight_bytes(w.shape),
+                "weight_packed_bytes": (
+                    packed_weight_bytes(w.shape)
+                    if binary
+                    else dense_weight_bytes(w.shape)
+                ),
+                "act_in_bytes": n_in * act_bpe,
+                "act_out_bytes": n_out * act_bpe,
+                "act_in_packed_bytes": (
+                    packed_activation_bytes(n_in)
+                    if binary
+                    else n_in * act_bpe
+                ),
+                "popcount_word_bytes": (
+                    (n_out // co) * popcount_word_bytes(kh, kw, ci)
+                    if binary
+                    else None
+                ),
+            }
+        rows.append(row)
+
+    def _interceptor(next_fun, args, kwargs, context):
+        out = next_fun(*args, **kwargs)
+        mod = context.module
+        if (
+            getattr(context, "method_name", "__call__") == "__call__"
+            and args
+            and hasattr(args[0], "shape")
+            and hasattr(out, "shape")
+            and (
+                isinstance(mod, fnn.Dense)
+                or (
+                    hasattr(mod, "kernel_size")
+                    and hasattr(mod, "features")
+                )
+            )
+        ):
+            _record(mod, tuple(args[0].shape), tuple(out.shape))
+        return out
+
+    with fnn.intercept_methods(_interceptor):
+        jax.eval_shape(
+            lambda v, xx: model.apply(v, xx, train=False), variables, x
+        )
+    return rows
+
+
+def layer_regimes(
+    row: Dict[str, Any], ceilings: Dict[str, Any]
+) -> Dict[str, Any]:
+    """The three byte regimes of one layer row: total HBM bytes,
+    intensity, bound class, roof ms. Non-binary layers price all three
+    regimes identically (packing does not apply), so regime deltas are
+    exactly the binary convs' — the table stays honest about where the
+    packing wins live."""
+    flops = float(row["flops"])
+    wd = int(row["weight_dense_bytes"])
+    wp = int(row["weight_packed_bytes"])
+    ai = int(row["act_in_bytes"])
+    ao = int(row["act_out_bytes"])
+    aip = int(row["act_in_packed_bytes"])
+    out: Dict[str, Any] = {}
+    for name, nbytes in (
+        ("dense", wd + ai + ao),
+        ("packed_weight", wp + ai + ao),
+        ("packed_act", wp + aip + ao),
+    ):
+        inten = arithmetic_intensity(flops, nbytes)
+        out[name] = {
+            "bytes": int(nbytes),
+            "intensity": round(inten, 3),
+            "bound": classify_bound(inten, ceilings),
+            "roof_ms": round(roof_ms(flops, nbytes, ceilings), 6),
+        }
+    return out
+
+
+def static_table(
+    rows: List[Dict[str, Any]], ceilings: Dict[str, Any]
+) -> List[Dict[str, Any]]:
+    """Layer rows + their :func:`layer_regimes` — the static half of
+    the verdict, one list per bucket."""
+    return [{**r, "regimes": layer_regimes(r, ceilings)} for r in rows]
+
+
+# ---------------------------------------------------------------------------
+# measured sweep + verdict
+# ---------------------------------------------------------------------------
+
+
+def _write_json_atomic(path: str, payload: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def run_perf(cfg) -> Dict[str, Any]:
+    """The ``perf`` subcommand: static roofline + measured bucket/impl
+    sweep + persisted ledger. Returns ``{"verdict", "run_dir"}``."""
+    import jax
+
+    from bdbnn_tpu.obs.events import EventWriter, jsonsafe
+    from bdbnn_tpu.obs.manifest import write_manifest
+    from bdbnn_tpu.obs.trace import (
+        attribute_trace_layers,
+        find_trace_file,
+        hlo_module_name,
+        hlo_op_scopes,
+    )
+    from bdbnn_tpu.serve.export import read_artifact
+
+    artifact = read_artifact(cfg.artifact)
+    arch = artifact["arch"]
+    dataset = artifact["dataset"]
+    model_dtype = artifact.get("model", {}).get("dtype", "float32")
+    twoblock = bool(artifact.get("model", {}).get("twoblock", False))
+    buckets = tuple(sorted({int(b) for b in cfg.buckets}))
+
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+    run_dir = os.path.join(cfg.log_path, stamp)
+    os.makedirs(run_dir, exist_ok=True)
+    manifest = write_manifest(run_dir, cfg, extra={"mode": "perf"})
+    writer = EventWriter(
+        run_dir, max_bytes=int(cfg.events_max_mb * 2**20)
+    )
+    try:
+        dev = jax.devices()[0]
+        ceilings = resolve_ceilings(
+            dev.device_kind, cfg.ceilings or None
+        )
+        writer.emit(
+            "perf",
+            phase="start",
+            run_dir=run_dir,
+            artifact=cfg.artifact,
+            arch=arch,
+            dataset=dataset,
+            device_kind=dev.device_kind,
+            buckets=list(buckets),
+            impls=list(cfg.impls),
+            iters=int(cfg.iters),
+        )
+
+        # static side: the cost model per bucket (batch size changes
+        # activation bytes, hence intensity and bound class)
+        layer_rows: Dict[int, List[Dict[str, Any]]] = {}
+        static: Dict[str, Any] = {}
+        for b in buckets:
+            rows = model_layer_table(
+                arch,
+                dataset,
+                b,
+                image_size=int(artifact["image_size"]),
+                dtype=model_dtype,
+                twoblock=twoblock,
+            )
+            layer_rows[b] = rows
+            static[str(b)] = static_table(rows, ceilings)
+
+        measured: Dict[str, Any] = {}
+        skipped: List[Dict[str, Any]] = []
+        perf_layers: Dict[str, float] = {}
+        if not cfg.static_only:
+            from bdbnn_tpu.serve.engine import InferenceEngine
+
+            for impl in cfg.impls:
+                if impl == "popcount" and model_dtype == "bfloat16":
+                    skipped.append({
+                        "impl": impl,
+                        "reason": (
+                            "popcount needs a float32 artifact; this "
+                            "one records dtype=bfloat16"
+                        ),
+                    })
+                    continue
+                engine = InferenceEngine(
+                    cfg.artifact,
+                    buckets=buckets,
+                    packed=impl != "dense",
+                    packed_impl=impl if impl != "dense" else "unpack",
+                )
+                regime = IMPL_REGIME.get(impl, "packed_weight")
+                per_bucket: Dict[str, Any] = {}
+                for b in buckets:
+                    tdir = os.path.join(
+                        run_dir, "traces", f"{impl}_b{b}"
+                    )
+                    t = engine.trace_step(
+                        tdir, bucket=b, iters=int(cfg.iters)
+                    )
+                    trace_file = find_trace_file(tdir)
+                    hlo = engine.hlo_text(b)
+                    att = (
+                        attribute_trace_layers(
+                            trace_file,
+                            t["iters"],
+                            layers={
+                                r["name"]: r["scope"]
+                                for r in layer_rows[b]
+                            },
+                            op_scopes=hlo_op_scopes(hlo),
+                            module=hlo_module_name(hlo),
+                        )
+                        if trace_file
+                        else None
+                    )
+                    stat_by_name = {
+                        r["name"]: r for r in static[str(b)]
+                    }
+                    layers_out: Dict[str, Any] = {}
+                    if att:
+                        for name, ms in att["layers"].items():
+                            reg = stat_by_name[name]["regimes"][regime]
+                            eff = (
+                                reg["roof_ms"] / ms if ms > 0 else None
+                            )
+                            layers_out[name] = {
+                                "ms": ms,
+                                "roof_ms": reg["roof_ms"],
+                                "efficiency": (
+                                    round(eff, 4)
+                                    if eff is not None
+                                    else None
+                                ),
+                                "bound": reg["bound"],
+                                "intensity": reg["intensity"],
+                            }
+                            perf_layers[f"{name}|b{b}|{impl}"] = ms
+                    wall = t["wall_ms"]
+                    recon = None
+                    if att and wall:
+                        attributed = round(
+                            sum(att["layers"].values()), 4
+                        )
+                        total = att["total_ms"]
+                        err = abs(total - wall) / wall
+                        recon = {
+                            "wall_ms": wall,
+                            "attributed_ms": attributed,
+                            "device_total_ms": total,
+                            "unattributed_ms": att["unattributed"],
+                            "abs_err_pct": round(err * 100.0, 2),
+                            "ok": err <= float(cfg.tol_reconcile),
+                        }
+                    per_bucket[str(b)] = {
+                        "wall_ms": wall,
+                        "traced": trace_file is not None,
+                        "layers": layers_out,
+                        "reconciliation": recon,
+                    }
+                    writer.emit(
+                        "perf",
+                        phase="bucket",
+                        impl=impl,
+                        bucket=b,
+                        wall_ms=wall,
+                        attributed_ms=(recon or {}).get(
+                            "attributed_ms"
+                        ),
+                        reconciled=(recon or {}).get("ok"),
+                    )
+                measured[impl] = per_bucket
+
+        summary = _summarize_measured(
+            measured, buckets, static, ceilings
+        )
+        verdict = jsonsafe({
+            "perf_verdict": PERF_SCHEMA_VERSION,
+            "artifact": cfg.artifact,
+            "arch": arch,
+            "dataset": dataset,
+            "dtype": model_dtype,
+            "device_kind": dev.device_kind,
+            "backend": dev.platform,
+            "buckets": list(buckets),
+            "impls": list(cfg.impls),
+            "iters": int(cfg.iters),
+            "ceilings": ceilings,
+            "static": static,
+            "measured": measured,
+            "skipped": skipped,
+            "perf_layers": perf_layers,
+            "summary": summary,
+            "provenance": {
+                "config_hash": manifest.get("config_hash"),
+                "device_kind": manifest.get("device_kind"),
+                "recipe": {
+                    "arch": arch,
+                    "dataset": dataset,
+                    "dtype": model_dtype,
+                    "twoblock": twoblock,
+                },
+            },
+        })
+        _write_json_atomic(
+            os.path.join(run_dir, PERF_VERDICT_NAME), verdict
+        )
+        if getattr(cfg, "out", ""):
+            _write_json_atomic(cfg.out, verdict)
+        _write_json_atomic(
+            os.path.join(run_dir, BENCH_ARTIFACT_NAME),
+            _bench_artifact(verdict),
+        )
+        ledger_line = jsonsafe({
+            "t": round(time.time(), 3),
+            "schema": PERF_SCHEMA_VERSION,
+            "run_dir": run_dir,
+            "config_hash": manifest.get("config_hash"),
+            "device_kind": dev.device_kind,
+            "arch": arch,
+            "dataset": dataset,
+            "dtype": model_dtype,
+            "summary": summary,
+            "perf_layers": perf_layers,
+            "skipped": [s["impl"] for s in skipped],
+        })
+        with open(
+            os.path.join(cfg.log_path, PERF_LEDGER_NAME), "a"
+        ) as f:
+            f.write(json.dumps(ledger_line, sort_keys=True) + "\n")
+        writer.emit(
+            "perf", phase="verdict", run_dir=run_dir, verdict=verdict
+        )
+    finally:
+        writer.close()
+    return {"verdict": verdict, "run_dir": run_dir}
+
+
+def _summarize_measured(
+    measured: Dict[str, Any],
+    buckets: Tuple[int, ...],
+    static: Dict[str, Any],
+    ceilings: Dict[str, Any],
+) -> Dict[str, Any]:
+    """Flat aggregates ``compare`` judges (the per-layer keys are
+    judged separately): best/dense/packed step ms at the LARGEST
+    bucket (the throughput-representative point), mean per-layer
+    efficiency, mean attributed share, and an MFU estimate at the
+    best step."""
+    big = str(buckets[-1]) if buckets else None
+    walls = {
+        impl: (pb.get(big) or {}).get("wall_ms")
+        for impl, pb in measured.items()
+    }
+    vals = [v for v in walls.values() if v is not None]
+    packed_vals = [
+        v for k, v in walls.items() if k != "dense" and v is not None
+    ]
+    effs = [
+        lay["efficiency"]
+        for pb in measured.values()
+        for bkt in pb.values()
+        for lay in bkt["layers"].values()
+        if lay["efficiency"] is not None
+    ]
+    shares = []
+    for pb in measured.values():
+        for bkt in pb.values():
+            recon = bkt.get("reconciliation")
+            if recon and recon.get("device_total_ms"):
+                shares.append(
+                    recon["attributed_ms"] / recon["device_total_ms"]
+                )
+    step_best = min(vals) if vals else None
+    mfu = None
+    if step_best and big:
+        flops = sum(float(r["flops"]) for r in static.get(big, []))
+        if flops:
+            mfu = round(
+                flops
+                / (step_best / 1e3)
+                / float(ceilings["peak_flops"]),
+                4,
+            )
+    return {
+        "bucket": int(big) if big else None,
+        "step_ms_best": step_best,
+        "step_ms_dense": walls.get("dense"),
+        "step_ms_packed": min(packed_vals) if packed_vals else None,
+        "efficiency_mean": (
+            round(sum(effs) / len(effs), 4) if effs else None
+        ),
+        "attributed_share": (
+            round(sum(shares) / len(shares), 4) if shares else None
+        ),
+        "mfu_best": mfu,
+    }
+
+
+def _bench_artifact(verdict: Dict[str, Any]) -> Dict[str, Any]:
+    """``BENCH_*``-compatible top-level summary: the ``parsed`` line
+    compare's bench-artifact path already reads (value = img/s at the
+    summary bucket, device_ms_per_step = best step ms) — so perf runs
+    populate the bench trajectory from schema'd data instead of
+    hand-rolled harness output."""
+    s = verdict.get("summary") or {}
+    step = s.get("step_ms_best")
+    bucket = s.get("bucket")
+    value = (
+        round(float(bucket) * 1000.0 / float(step), 2)
+        if step and bucket
+        else None
+    )
+    return {
+        "bench": "perf",
+        "schema": PERF_SCHEMA_VERSION,
+        "parsed": {
+            "metric": "img_per_s",
+            "value": value,
+            "device_ms_per_step": step,
+            "device_mfu": s.get("mfu_best"),
+            "device_kind": verdict.get("device_kind"),
+            "dtype": verdict.get("dtype"),
+        },
+        "provenance": (verdict.get("provenance") or {}),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering (CLI + summarize share it)
+# ---------------------------------------------------------------------------
+
+
+def render_perf(verdict: Dict[str, Any]) -> str:
+    """Human tables for one perf verdict: ceilings line, per-bucket
+    bound-class table (static), and per-(impl, bucket) layer
+    efficiency with reconciliation."""
+    c = verdict.get("ceilings") or {}
+    lines = [
+        f"== Perf roofline: {verdict.get('arch')}/"
+        f"{verdict.get('dataset')} on {verdict.get('device_kind')} "
+        f"({verdict.get('dtype')})"
+    ]
+    if c:
+        lines.append(
+            f"ceilings[{c.get('matched')}]: "
+            f"{c.get('peak_flops', 0) / 1e12:.4g} TFLOP/s, "
+            f"{c.get('hbm_gbs', 0):.4g} GB/s "
+            f"(ridge {c.get('ridge_intensity')} FLOP/byte)"
+        )
+    for b, rows in sorted(
+        (verdict.get("static") or {}).items(), key=lambda kv: int(kv[0])
+    ):
+        counts: Dict[str, Any] = {}
+        for r in rows:
+            for reg, info in r["regimes"].items():
+                counts.setdefault(reg, {"memory": 0, "compute": 0})
+                counts[reg][info["bound"]] += 1
+        parts = ", ".join(
+            f"{reg}: {v['memory']}M/{v['compute']}C"
+            for reg, v in sorted(counts.items())
+        )
+        lines.append(f"bucket {b} bound classes ({parts})")
+    for impl, pb in sorted((verdict.get("measured") or {}).items()):
+        for b, bkt in sorted(pb.items(), key=lambda kv: int(kv[0])):
+            recon = bkt.get("reconciliation") or {}
+            ok = recon.get("ok")
+            lines.append(
+                f"-- {impl} b{b}: wall {bkt.get('wall_ms')} ms, "
+                f"attributed {recon.get('attributed_ms')} ms, "
+                f"reconcile "
+                f"{'ok' if ok else 'MISS' if ok is not None else 'n/a'}"
+                f" (err {recon.get('abs_err_pct')}%)"
+            )
+            layers = bkt.get("layers") or {}
+            if layers:
+                lines.append(
+                    f"   {'layer':<24} {'ms':>8} {'roof':>9} "
+                    f"{'eff':>6}  bound"
+                )
+                for name, lay in sorted(
+                    layers.items(), key=lambda kv: -kv[1]["ms"]
+                ):
+                    eff = lay.get("efficiency")
+                    lines.append(
+                        f"   {name:<24} {lay['ms']:>8.3f} "
+                        f"{lay['roof_ms']:>9.4f} "
+                        f"{eff if eff is not None else '-':>6} "
+                        f" {lay['bound']}"
+                    )
+    s = verdict.get("summary") or {}
+    if s:
+        lines.append(
+            f"summary: best {s.get('step_ms_best')} ms @ bucket "
+            f"{s.get('bucket')} (dense {s.get('step_ms_dense')}, "
+            f"packed {s.get('step_ms_packed')}), efficiency mean "
+            f"{s.get('efficiency_mean')}, attributed share "
+            f"{s.get('attributed_share')}"
+        )
+    for skip in verdict.get("skipped") or []:
+        lines.append(
+            f"skipped {skip.get('impl')}: {skip.get('reason')}"
+        )
+    return "\n".join(lines)
+
+
+__all__ = [
+    "BENCH_ARTIFACT_NAME",
+    "CEILINGS",
+    "IMPL_REGIME",
+    "PERF_LEDGER_NAME",
+    "PERF_SCHEMA_VERSION",
+    "PERF_VERDICT_NAME",
+    "arithmetic_intensity",
+    "classify_bound",
+    "layer_regimes",
+    "model_layer_table",
+    "render_perf",
+    "resolve_ceilings",
+    "ridge_intensity",
+    "roof_ms",
+    "run_perf",
+    "static_table",
+]

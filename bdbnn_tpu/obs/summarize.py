@@ -427,6 +427,53 @@ def _search(events) -> Optional[Dict[str, Any]]:
     return out
 
 
+def _perf(events) -> Optional[Dict[str, Any]]:
+    """The performance-observatory section (obs/roofline.py): the
+    sweep header from the ``perf`` start event, the measured
+    (impl, bucket) cells that landed, and the roofline verdict's
+    summary/ceilings/skips when one landed. None when the timeline
+    carries no perf telemetry."""
+    perf = [e for e in events if e.get("kind") == "perf"]
+    if not perf:
+        return None
+    start = next((e for e in perf if e.get("phase") == "start"), None)
+    verdict_ev = next(
+        (e for e in reversed(perf) if e.get("phase") == "verdict"), None
+    )
+    cells = [
+        {
+            k: e.get(k)
+            for k in ("impl", "bucket", "wall_ms", "attributed_ms",
+                      "reconciled")
+        }
+        for e in perf
+        if e.get("phase") == "bucket"
+    ]
+    out: Dict[str, Any] = {
+        "start": (
+            {
+                k: start.get(k)
+                for k in ("artifact", "arch", "dataset", "device_kind",
+                          "buckets", "impls", "iters")
+            }
+            if start
+            else None
+        ),
+        "cells": cells,
+        "verdict": None,
+    }
+    if verdict_ev is not None:
+        v = verdict_ev.get("verdict") or {}
+        out["verdict"] = {
+            "summary": v.get("summary"),
+            "ceilings": v.get("ceilings"),
+            "skipped": v.get("skipped"),
+            "perf_layer_keys": len(v.get("perf_layers") or {}),
+            "run_dir": verdict_ev.get("run_dir"),
+        }
+    return out
+
+
 def _resilience(manifest, events) -> Dict[str, Any]:
     """Checkpoint/restart posture: how much work a preemption would
     cost right now, and how this run relates to its ancestors."""
@@ -522,6 +569,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
     health = _health(events)
     serving = _serving(events)
     search = _search(events)
+    perf = _perf(events)
     # the LAST static-analysis verdict recorded on this timeline
     # (`check --events-into RUN_DIR`, bdbnn_tpu/analysis/)
     analysis_ev = next(
@@ -565,6 +613,7 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
         "health": health,
         "serving": serving,
         "search": search,
+        "perf": perf,
         "analysis": analysis,
         "nonfinite_intervals": len(nonfinite),
     }
@@ -697,6 +746,48 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                     f"({best.get('family')} @ lr {best.get('lr')}) "
                     f"best_top1 {best.get('best_top1')}"
                 )
+    if perf:
+        ps = perf.get("start") or {}
+        lines.append(
+            f"perf observatory: roofline sweep on {ps.get('arch')} "
+            f"({ps.get('artifact')}) | buckets {ps.get('buckets')} x "
+            f"impls {ps.get('impls')} | {ps.get('iters')} iters on "
+            f"{ps.get('device_kind')}"
+        )
+        pv = perf.get("verdict")
+        if pv:
+            s = pv.get("summary") or {}
+            ceil = pv.get("ceilings") or {}
+            lines.append(
+                f"  ceilings: {ceil.get('matched')} — "
+                f"{ceil.get('peak_flops')} FLOP/s peak, "
+                f"{ceil.get('hbm_gbs')} GB/s HBM (ridge "
+                f"{ceil.get('ridge_intensity')} flop/byte)"
+            )
+            lines.append(
+                f"  best {s.get('step_ms_best')} ms/step @ b"
+                f"{s.get('bucket')} | dense {s.get('step_ms_dense')} / "
+                f"packed {s.get('step_ms_packed')} ms | roof "
+                f"efficiency {s.get('efficiency_mean')} | attributed "
+                f"{s.get('attributed_share')} | mfu {s.get('mfu_best')}"
+                f" | {pv.get('perf_layer_keys')} per-layer key(s)"
+            )
+            for skip in pv.get("skipped") or []:
+                lines.append(
+                    f"  skipped {skip.get('impl')}: {skip.get('reason')}"
+                )
+        for c in perf.get("cells") or []:
+            recon = c.get("reconciled")
+            lines.append(
+                f"  {c.get('impl')} b{c.get('bucket')}: "
+                f"{c.get('wall_ms')} ms/step, attributed "
+                f"{c.get('attributed_ms')} ms "
+                + (
+                    "(reconciled)" if recon
+                    else "(RECONCILIATION BROKEN)" if recon is False
+                    else "(unreconciled)"
+                )
+            )
     if serving:
         for ex in serving["exports"]:
             lines.append(

@@ -348,6 +348,145 @@ def hlo_breakdown(
     return out, (step_total / steps if step_total else None)
 
 
+# ---------------------------------------------------------------------------
+# per-layer attribution (obs/roofline.py's measured side)
+# ---------------------------------------------------------------------------
+
+# an HLO instruction line in `compiled.as_text()` with framework scope
+# metadata: `  %convolution.119 = f32[...] convolution(...),
+# metadata={op_name="jit(_apply)/jit(main)/BiResNet/layer1_0/conv1/..."
+# ...}`. The instruction name (sans %) is exactly what CPU-backend
+# profiler op events carry as args["hlo_op"].
+_HLO_INSTR_SCOPE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s[^\n]*?"
+    r"metadata=\{[^}\n]*?op_name=\"([^\"]+)\"",
+    re.M,
+)
+_HLO_MODULE = re.compile(r"^HloModule\s+([\w.\-]+)", re.M)
+
+
+def hlo_op_scopes(hlo_text: str) -> Dict[str, str]:
+    """``{instruction_name: framework_scope_path}`` from optimized HLO
+    text (``compiled.as_text()``).
+
+    Why this exists: TPU traces carry the named-scope path on each op
+    event (``tf_op`` — what :func:`_span_of` consumes), but the CPU
+    backend emits op events whose ``tf_op`` is EMPTY; only ``hlo_op``
+    (the instruction name, e.g. ``convolution.119``) survives. The
+    compiled executable's own HLO text still records the full
+    ``op_name`` scope per instruction — parsing it restores the join
+    on any backend, including for fusion instructions (their metadata
+    names the representative op's scope)."""
+    return {
+        m.group(1): m.group(2)
+        for m in _HLO_INSTR_SCOPE.finditer(hlo_text or "")
+    }
+
+
+def hlo_module_name(hlo_text: str) -> Optional[str]:
+    """The ``HloModule`` header name (e.g. ``jit__apply``), for
+    filtering trace op events down to one executable via their
+    ``hlo_module`` metadata arg."""
+    m = _HLO_MODULE.search(hlo_text or "")
+    return m.group(1) if m else None
+
+
+def _match_needle(segs: List[str], needle_segs: List[str]) -> bool:
+    """True if ``needle_segs`` occurs as a consecutive run in ``segs``,
+    comparing each segment exactly or after stripping a trailing
+    ``.N``/digit disambiguator (scope paths repeat a module name as
+    ``conv1_1`` only via flax, which is part of the needle itself —
+    the stripping only drops XLA's appended indices)."""
+    n = len(needle_segs)
+    for i in range(len(segs) - n + 1):
+        ok = True
+        for j in range(n):
+            s = segs[i + j]
+            if s != needle_segs[j] and _TRAILING_IDX.sub("", s) != (
+                needle_segs[j]
+            ):
+                ok = False
+                break
+        if ok:
+            return True
+    return False
+
+
+def attribute_trace_layers(
+    source: TraceSource,
+    n_steps: int,
+    *,
+    layers: Dict[str, str],
+    op_scopes: Optional[Dict[str, str]] = None,
+    module: Optional[str] = None,
+    step_prefix: str = "jit_",
+) -> Dict[str, Any]:
+    """Per-LAYER device ms/step — the measured half of the roofline.
+
+    ``layers`` maps display names to module scope paths (e.g.
+    ``{"layer1_0.conv1": "layer1_0/conv1"}``, from
+    :func:`bdbnn_tpu.obs.roofline.model_layer_table`). Each device op
+    event resolves its scope path via ``op_scopes[hlo_op]`` (the
+    compiled-HLO join above) when given, falling back to the event's
+    own string metadata (``tf_op`` — the TPU path); the op is charged
+    to the layer whose scope segments occur consecutively in that path,
+    LONGEST needle first — so the stem ``conv1`` can never swallow
+    ``layer1_0/conv1``'s ops. Ops matching no layer (BN/residual/pad,
+    input transfers) pool under ``"unattributed"``; ``total_ms`` is the
+    full device-op time per step, the number reconciled against the
+    engine's ``time_step`` wall. ``module`` (see
+    :func:`hlo_module_name`) drops op events from other executables
+    that share the capture window."""
+    events = load_trace_events(source)
+    steps = max(int(n_steps or 0), 1)
+    device_ops, _, _ = _split_events(events, step_prefix)
+
+    ordered = sorted(
+        layers.items(),
+        key=lambda kv: (-len([s for s in kv[1].split("/") if s]), kv[0]),
+    )
+    needles = [
+        (name, [s for s in scope.split("/") if s])
+        for name, scope in ordered
+    ]
+
+    per_layer = {name: 0.0 for name in layers}
+    unattributed = 0.0
+    total = 0.0
+    for e in device_ops:
+        args = e.get("args") or {}
+        if module and str(args.get("hlo_module", module)) != module:
+            continue
+        dur_ms = float(e.get("dur", 0)) / 1e3
+        hlo_op = str(args.get("hlo_op") or e.get("name", ""))
+        scope = (op_scopes or {}).get(hlo_op)
+        candidates = [scope] if scope else [
+            v for v in args.values() if isinstance(v, str) and "/" in v
+        ]
+        hit = None
+        for cand in candidates:
+            segs = [s for s in cand.split("/") if s]
+            for name, nsegs in needles:
+                if _match_needle(segs, nsegs):
+                    hit = name
+                    break
+            if hit:
+                break
+        if hit is not None:
+            per_layer[hit] += dur_ms
+        else:
+            unattributed += dur_ms
+        total += dur_ms
+    return {
+        "n_steps": steps,
+        "layers": {
+            k: round(v / steps, 4) for k, v in per_layer.items() if v > 0.0
+        },
+        "unattributed": round(unattributed / steps, 4),
+        "total_ms": round(total / steps, 4),
+    }
+
+
 def jit_step_ms(
     source: TraceSource, prefix: str = "jit_train_step"
 ) -> Optional[float]:

@@ -580,6 +580,57 @@ def _serve_lines(events) -> List[str]:
     return lines
 
 
+def _perf_lines(events) -> List[str]:
+    """The perf-observatory view: when a timeline carries ``perf``
+    events (a ``perf`` run dir, obs/roofline.py) render the sweep
+    header, one line per measured (impl, bucket) cell as it lands,
+    and the roofline summary once the verdict event arrives."""
+    perf = [e for e in events if e.get("kind") == "perf"]
+    if not perf:
+        return []
+    lines: List[str] = []
+    start = next((e for e in perf if e.get("phase") == "start"), None)
+    verdict_ev = next(
+        (e for e in reversed(perf) if e.get("phase") == "verdict"), None
+    )
+    if start:
+        lines.append(
+            f"perf: roofline sweep on {start.get('arch')} | buckets "
+            f"{start.get('buckets')} x impls {start.get('impls')} | "
+            f"{start.get('iters')} iters on {start.get('device_kind')}"
+        )
+    if verdict_ev is None:
+        for e in perf:
+            if e.get("phase") != "bucket":
+                continue
+            recon = e.get("reconciled")
+            mark = (
+                "reconciled" if recon
+                else "RECONCILIATION BROKEN" if recon is False
+                else "unreconciled"
+            )
+            lines.append(
+                f"  {e.get('impl')} b{e.get('bucket')}: "
+                f"{e.get('wall_ms')} ms/step (attributed "
+                f"{e.get('attributed_ms')} ms, {mark})"
+            )
+        return lines
+    v = verdict_ev.get("verdict") or {}
+    s = v.get("summary") or {}
+    lines.append(
+        f"  VERDICT: best {s.get('step_ms_best')} ms/step @ b"
+        f"{s.get('bucket')} | dense {s.get('step_ms_dense')} / packed "
+        f"{s.get('step_ms_packed')} ms | roof efficiency "
+        f"{s.get('efficiency_mean')} | attributed "
+        f"{s.get('attributed_share')} | mfu {s.get('mfu_best')}"
+    )
+    for skip in v.get("skipped") or []:
+        lines.append(
+            f"  skipped {skip.get('impl')}: {skip.get('reason')}"
+        )
+    return lines
+
+
 def _search_lines(events) -> List[str]:
     """The recipe-search view: when a timeline carries ``search``/
     ``trial`` events (a sweep dir, bdbnn_tpu/search/) render the live
@@ -671,6 +722,7 @@ def render_status(
 
     lines = []
     lines += _search_lines(events)
+    lines += _perf_lines(events)
     lines += _serve_lines(events)
     if start:
         lines.append(
@@ -804,13 +856,18 @@ def watch_run(
             events = read_events(run_dir)
             out(render_status(events, manifest))
             # a serve-bench run ends at its verdict, a search sweep at
-            # its leaderboard verdict, a training run at run_end — any
-            # of them terminates the tail
+            # its leaderboard verdict, a perf sweep at its roofline
+            # verdict, a training run at run_end — any of them
+            # terminates the tail
             if once or any(
                 e.get("kind") == "run_end"
                 or (e.get("kind") == "serve" and e.get("phase") == "verdict")
                 or (
                     e.get("kind") == "search"
+                    and e.get("phase") == "verdict"
+                )
+                or (
+                    e.get("kind") == "perf"
                     and e.get("phase") == "verdict"
                 )
                 for e in events

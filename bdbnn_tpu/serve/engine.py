@@ -33,6 +33,7 @@ modes for the weights:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -130,6 +131,9 @@ class InferenceEngine:
         from collections import deque
 
         self._step_ms_window: Any = deque(maxlen=512)
+        # per-bucket activation working-set cache (residency(); the
+        # layer table behind it costs one eval_shape per bucket)
+        self._act_ws: Dict[int, Any] = {}
         if warm:
             self.warmup()
 
@@ -185,12 +189,68 @@ class InferenceEngine:
 
     # -- residency accounting ------------------------------------------
 
+    def _activation_working_set(self) -> Dict[str, Any]:
+        """Per-bucket activation working-set estimate: f32 bytes in/out
+        of every conv at each bucket's batch size (plus the fc row),
+        from the roofline layer table — the gate metric the ROADMAP's
+        end-to-end activation-packing item names. Cached per bucket
+        (one ``eval_shape`` each, no device work). Never raises: an
+        arch the shape tracer cannot walk reports an ``error`` string
+        instead of breaking residency for serving callers."""
+        from bdbnn_tpu.obs.roofline import model_layer_table
+
+        out: Dict[str, Any] = {}
+        for b in self.buckets:
+            if b not in self._act_ws:
+                try:
+                    rows = model_layer_table(
+                        self.arch,
+                        self.dataset,
+                        b,
+                        image_size=self.image_size,
+                        dtype=self.artifact.get("model", {}).get(
+                            "dtype", "float32"
+                        ),
+                        twoblock=bool(
+                            self.artifact.get("model", {}).get(
+                                "twoblock", False
+                            )
+                        ),
+                    )
+                    per_conv = {
+                        r["name"]: {
+                            "in": int(r["act_in_bytes"]),
+                            "out": int(r["act_out_bytes"]),
+                        }
+                        for r in rows
+                    }
+                    self._act_ws[b] = {
+                        "bytes_in": sum(
+                            v["in"] for v in per_conv.values()
+                        ),
+                        "bytes_out": sum(
+                            v["out"] for v in per_conv.values()
+                        ),
+                        "per_conv": per_conv,
+                    }
+                except Exception as e:  # pragma: no cover - defensive
+                    self._act_ws[b] = {"error": str(e)}
+            out[str(b)] = self._act_ws[b]
+        return out
+
     def residency(self) -> Dict[str, Any]:
         """Resident weight-memory report: the bytes this engine keeps
         alive in device memory, the bytes the OTHER mode would keep for
-        the same artifact, and their ratio — what the ``memory``
-        serve events and the A/B verdict's ``packed`` block record."""
+        the same artifact, their ratio — what the ``memory`` serve
+        events and the A/B verdict's ``packed`` block record — plus the
+        per-bucket activation working set (``activations``), the
+        counterpart number activation packing would shrink."""
         import jax
+
+        from bdbnn_tpu.nn.packed import (
+            dense_weight_bytes,
+            packed_weight_bytes,
+        )
 
         resident = int(
             sum(
@@ -198,25 +258,29 @@ class InferenceEngine:
                 for x in jax.tree_util.tree_leaves(self._variables)
             )
         )
+        activations = self._activation_working_set()
         if self.packed:
             dense_equiv = int(self._packed_spec["dense_equiv_bytes"])
         else:
             # what load_artifact_packed would keep resident: swap each
             # binary conv's dense f32 tensor for packbits sign + alpha
+            # (the shared byte hooks in nn/packed.py — the same math
+            # the roofline's packed-weight regime prices)
             dense_equiv = resident
             packed_equiv = resident
             for t in self.artifact.get("tensors", []):
                 if t["kind"] != "binary":
                     continue
-                n = int(np.prod(t["shape"]))
-                out_ch = int(t["shape"][-1])
-                packed_equiv += -(n * 4) + ((n + 7) // 8 + out_ch * 4)
+                packed_equiv += packed_weight_bytes(
+                    t["shape"]
+                ) - dense_weight_bytes(t["shape"])
             return {
                 "packed": False,
                 "resident_bytes": resident,
                 "dense_equiv_bytes": dense_equiv,
                 "packed_equiv_bytes": packed_equiv,
                 "ratio": round(resident / max(packed_equiv, 1), 3),
+                "activations": activations,
             }
         return {
             "packed": True,
@@ -224,6 +288,7 @@ class InferenceEngine:
             "dense_equiv_bytes": dense_equiv,
             "packed_equiv_bytes": resident,
             "ratio": round(dense_equiv / max(resident, 1), 3),
+            "activations": activations,
         }
 
     def time_step(
@@ -245,6 +310,54 @@ class InferenceEngine:
         return round(
             (time.perf_counter() - t0) * 1000.0 / max(int(iters), 1), 3
         )
+
+    def hlo_text(self, bucket: Optional[int] = None) -> str:
+        """Optimized HLO text of a bucket's compiled executable — the
+        per-instruction ``op_name`` scope metadata in here is what
+        joins profiler op events back to model layers on backends
+        whose trace events carry no ``tf_op`` (CPU); see
+        ``obs.trace.hlo_op_scopes``."""
+        b = self.buckets[-1] if bucket is None else int(bucket)
+        if b not in self._compiled:
+            self.warmup()
+        return self._compiled[b].as_text()
+
+    def trace_step(
+        self,
+        trace_dir: str,
+        bucket: Optional[int] = None,
+        iters: int = 10,
+    ) -> Dict[str, Any]:
+        """``time_step`` with a profiler window around the timed loop:
+        same input recipe, same one unmeasured warmup call (OUTSIDE the
+        window, so allocator warmup taints neither the mean nor the
+        trace), then ``iters`` measured steps inside
+        ``jax.profiler.trace``. Returns the wall mean alongside the
+        trace dir so the roofline harness can reconcile per-op trace
+        time against the very wall it was captured under."""
+        import jax
+
+        b = self.buckets[-1] if bucket is None else int(bucket)
+        if b not in self._compiled:
+            self.warmup()
+        n = max(int(iters), 1)
+        x = np.zeros((b, self.image_size, self.image_size, 3), np.float32)
+        self._compiled[b](self._variables, x).block_until_ready()
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                self._compiled[b](self._variables, x).block_until_ready()
+            wall_ms = (time.perf_counter() - t0) * 1000.0 / n
+        finally:
+            jax.profiler.stop_trace()
+        return {
+            "bucket": b,
+            "iters": n,
+            "wall_ms": round(wall_ms, 3),
+            "trace_dir": trace_dir,
+        }
 
     def step_stats(self) -> Dict[str, Any]:
         """Percentiles of the rolling blocked-compute window (host

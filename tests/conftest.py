@@ -43,6 +43,7 @@ _NOT_FAST_CLASSES = {
     "TestSummarizeStrict",
     "TestCompareSubcommand",
     "TestServeCliSmoke",
+    "TestPerfCliSmoke",
 }
 
 

@@ -462,6 +462,66 @@ class TestServeCliSmoke:
         assert result["top1"] == exported["checkpoint_acc1"]
         assert result["count"] == 64
 
+
+class TestPerfCliSmoke:
+    """The performance observatory's console surface as one real
+    subprocess: ``perf``-sweep the session's exported artifact (one
+    bucket, dense impl, 2 iters — the smallest honest sweep), then
+    ``compare`` the verdict against a doctored copy with one layer 2x
+    slower — exit 3, the perf regression gate."""
+
+    def test_perf_then_compare_gate(self, exported_artifact, tmp_path):
+        art, _ = exported_artifact
+        log = str(tmp_path / "perf_log")
+        out = str(tmp_path / "perf_verdict.json")
+        cand = str(tmp_path / "doctored.json")
+        driver = (
+            "import contextlib, io, json, sys\n"
+            "from bdbnn_tpu.cli import main\n"
+            "buf = io.StringIO()\n"
+            "with contextlib.redirect_stdout(buf):\n"
+            f"    rc = main(['perf', {art!r}, '--log-path', {log!r},\n"
+            "               '--buckets', '1', '--impls', 'dense',\n"
+            f"               '--iters', '2', '--out', {out!r}])\n"
+            "assert rc == 0, rc\n"
+            "v = json.loads(buf.getvalue())\n"
+            f"doc = json.load(open({out!r}))\n"
+            "key = sorted(doc['perf_layers'])[0]\n"
+            "doc['perf_layers'][key] *= 2.0\n"
+            f"json.dump(doc, open({cand!r}, 'w'))\n"
+            "with contextlib.redirect_stdout(io.StringIO()):\n"
+            f"    rc = main(['compare', {out!r}, {cand!r}])\n"
+            "assert rc == 3, rc\n"
+            "print(json.dumps({'perf_verdict': v['perf_verdict'],\n"
+            "                  'best': v['summary']['step_ms_best'],\n"
+            "                  'layers': len(v['perf_layers'])}))\n"
+            "sys.exit(0)\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", driver],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, (
+            proc.stdout[-800:] + proc.stderr[-800:]
+        )
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result["perf_verdict"] == 1
+        assert result["best"] > 0
+        assert result["layers"] == 7  # 7 layers x 1 bucket x 1 impl
+        # the persisted surface: one ledger line, a populated run dir
+        ledger = os.path.join(log, "PERF_LEDGER.jsonl")
+        with open(ledger) as f:
+            lines = [l for l in f if l.strip()]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["arch"] == "resnet8_tiny"
+        assert os.path.isfile(
+            os.path.join(rec["run_dir"], "BENCH_perf.json")
+        )
+
+
 class TestCheckSubcommand:
     """The static analyzer's console entrypoint as a real subprocess
     (bdbnn_tpu/analysis/ via ``python -m bdbnn_tpu.cli check``): exit 0

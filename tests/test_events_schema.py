@@ -66,7 +66,8 @@ class TestEmitCallSites:
         (serve/fleet.py), and the static analyzer's own ``analysis``
         kind (the `check --events-into` emit in cli.py), and the
         recipe-search harness's ``search``/``trial`` kinds
-        (bdbnn_tpu/search/harness.py)."""
+        (bdbnn_tpu/search/harness.py), and the performance
+        observatory's ``perf`` kind (bdbnn_tpu/obs/roofline.py)."""
         _findings, found = scan_events(REPO, SCANNED)
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
@@ -74,7 +75,7 @@ class TestEmitCallSites:
                 "alert", "health", "export", "serve",
                 "http", "admission", "replica", "swap", "fleet",
                 "rtrace", "canary", "shadow", "search", "trial",
-                "analysis"} <= found
+                "analysis", "perf"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync
@@ -124,6 +125,42 @@ class TestStrictRfc8259:
         assert rec["zerod"] == 3.25
         assert rec["nested"]["k"]["deep"] is None
         assert rec["arr"] == [0.5, None, 2]
+
+    def test_perf_payload_roundtrips(self, tmp_path):
+        """The perf observatory's worst-case payload: a roofline
+        efficiency that divided by a zero measurement (NaN), numpy
+        scalars from the trace join, and the nested per-layer map —
+        the ledger line and the ``perf`` verdict event must both stay
+        strict RFC 8259."""
+        ev = EventWriter(str(tmp_path))
+        ev.emit(
+            "perf",
+            phase="verdict",
+            verdict={
+                "summary": {
+                    "step_ms_best": np.float32(4.358),
+                    "efficiency_mean": float("nan"),
+                    "mfu_best": np.float64("inf"),
+                    "bucket": np.int64(8),
+                },
+                "perf_layers": {
+                    "conv1|b8|unpack": np.float32(0.25),
+                    "fc|b8|unpack": float("nan"),
+                },
+            },
+        )
+        ev.close()
+        with open(ev.path) as f:
+            rec = self._strict(f.read().strip())
+        s = rec["verdict"]["summary"]
+        assert s["step_ms_best"] == pytest.approx(4.358)
+        assert isinstance(s["step_ms_best"], float)
+        assert s["efficiency_mean"] is None  # NaN -> null
+        assert s["mfu_best"] is None  # inf -> null
+        assert s["bucket"] == 8 and isinstance(s["bucket"], int)
+        layers = rec["verdict"]["perf_layers"]
+        assert layers["conv1|b8|unpack"] == pytest.approx(0.25)
+        assert layers["fc|b8|unpack"] is None
 
     def test_every_known_kind_emits_strict(self, tmp_path):
         """One adversarial record per registered kind: whatever fields
