@@ -51,6 +51,10 @@ PRODUCER_FILES = (
     # v7 fleet_attribution block (whose serve_fleet_* gates
     # _serve_metrics reads) are produced here
     "bdbnn_tpu/serve/fleet.py",
+    # the capacity observatory: the v8 capacity block's flat gates
+    # (burn_rate_max / headroom_rps / demand_shed_ratio_max read by
+    # _serve_metrics) are assembled here
+    "bdbnn_tpu/obs/capacity.py",
 )
 
 # every judged verdict family: (flattener function in compare.py,

@@ -867,7 +867,13 @@ def serve_http_main(argv) -> int:
     )
     ap.add_argument(
         "--slo-p99-ms", type=float, default=0.0,
-        help="priority-0 p99 target judged in the verdict (0 = off)",
+        help="priority-0 p99 target judged in the verdict (0 = off); "
+        "also arms the capacity plane's latency burn-rate detectors",
+    )
+    ap.add_argument(
+        "--slo-shed-rate", type=float, default=0.0,
+        help="budgeted shed fraction per priority class for the "
+        "capacity plane's burn-rate detectors (0 = off)",
     )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
@@ -1011,6 +1017,7 @@ def serve_http_main(argv) -> int:
         tenants=tuple(args.tenants),
         tenant_weights=tuple(args.tenant_weights),
         slo_p99_ms=args.slo_p99_ms,
+        slo_shed_rate=args.slo_shed_rate,
         seed=args.seed,
         out=args.out,
         events_max_mb=args.events_max_mb,
@@ -1210,6 +1217,7 @@ def serve_fleet_main(argv) -> int:
         "--tenant-weights", type=float, nargs="+", default=[],
     )
     ap.add_argument("--slo-p99-ms", type=float, default=0.0)
+    ap.add_argument("--slo-shed-rate", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
         "--out", default="", help="also write the SLO verdict JSON here",
@@ -1300,6 +1308,7 @@ def serve_fleet_main(argv) -> int:
         tenants=tuple(args.tenants),
         tenant_weights=tuple(args.tenant_weights),
         slo_p99_ms=args.slo_p99_ms,
+        slo_shed_rate=args.slo_shed_rate,
         seed=args.seed,
         out=args.out,
         stats_interval_s=args.stats_interval_s,

@@ -498,6 +498,11 @@ class ServeHttpConfig:
     tenant_weights: Tuple[float, ...] = ()
     # SLO judged at verdict time: priority-0 p99 target in ms (0 = off)
     slo_p99_ms: float = 0.0
+    # shed-fraction SLO objective for the capacity plane's burn-rate
+    # detectors (obs/capacity.py): budgeted shed fraction per priority
+    # class (0 = off). Also arms the latency burn-rate detectors when
+    # --slo-p99-ms is set.
+    slo_shed_rate: float = 0.0
     seed: int = 0
     out: str = ""  # also write the SLO verdict JSON here
     stats_interval_s: float = 1.0  # cadence of live `http` stats events
@@ -636,6 +641,10 @@ class ServeHttpConfig:
             raise ValueError("--slow-fraction must be in [0, 1]")
         if self.slo_p99_ms < 0:
             raise ValueError("--slo-p99-ms must be >= 0 (0 disables)")
+        if not 0.0 <= self.slo_shed_rate <= 1.0:
+            raise ValueError(
+                "--slo-shed-rate must be in [0, 1] (0 disables)"
+            )
         if self.stats_interval_s <= 0:
             raise ValueError("--stats-interval-s must be > 0")
         if self.max_body_mb <= 0:
@@ -866,6 +875,10 @@ class ServeFleetConfig:
     tenants: Tuple[str, ...] = ("tenant-a", "tenant-b")
     tenant_weights: Tuple[float, ...] = ()
     slo_p99_ms: float = 0.0
+    # budgeted shed fraction for the backends' capacity planes — the
+    # router records it in its manifest; each HOST's own
+    # --slo-shed-rate arms the detectors the router's scrape merges
+    slo_shed_rate: float = 0.0
     seed: int = 0
     out: str = ""
     stats_interval_s: float = 1.0
@@ -977,6 +990,10 @@ class ServeFleetConfig:
             raise ValueError("--slow-fraction must be in [0, 1]")
         if self.slo_p99_ms < 0:
             raise ValueError("--slo-p99-ms must be >= 0 (0 disables)")
+        if not 0.0 <= self.slo_shed_rate <= 1.0:
+            raise ValueError(
+                "--slo-shed-rate must be in [0, 1] (0 disables)"
+            )
         if self.stats_interval_s <= 0:
             raise ValueError("--stats-interval-s must be > 0")
         if self.events_max_mb < 0:

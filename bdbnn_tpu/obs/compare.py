@@ -159,6 +159,20 @@ METRIC_SPECS: Tuple[Tuple[str, str, str], ...] = (
     ("serve_fleet_p99_network_ms", "lower", "rel"),
     ("serve_fleet_retry_hop_share", "lower", "rel"),
     ("serve_fleet_stage_spread_max", "lower", "rel"),
+    # v8 capacity block (obs/capacity.py): the capacity observatory's
+    # three flat gates. Worst burn rate catches a build that started
+    # torching its error budget (burn is already normalized against
+    # the objective, so ANY wedged increase against a calm baseline is
+    # a regression — rel tolerance of a zero baseline is zero);
+    # headroom rps (higher — shrinking saturation margin at the same
+    # offered load is a capacity regression even when the p99 held);
+    # worst per-key shed ratio catches one (model, tenant, priority)
+    # key being starved behind a healthy aggregate. v1-v7 verdicts
+    # (no capacity block) leave all three None, so they skip cleanly
+    # in BOTH directions.
+    ("serve_burn_rate_max", "lower", "rel"),
+    ("serve_headroom_rps", "higher", "rel"),
+    ("serve_demand_shed_ratio_max", "lower", "rel"),
     # recipe-search leaderboards (bdbnn_tpu/search/): the winning
     # trial's best top-1 (absolute pp tolerance, like the training
     # accuracies) and its time to the sweep's common-accuracy level —
@@ -289,6 +303,17 @@ def _serve_metrics(verdict: Dict[str, Any]) -> Dict[str, Any]:
     )
     out["serve_fleet_stage_spread_max"] = (
         (fa or {}).get("host_stage_spread_max")
+    )
+    # v8 capacity block (obs/capacity.py): the observatory publishes
+    # its three gates FLAT at the block's top level (host and fleet
+    # producers alike) exactly so these reads stay constant-subscript.
+    # Absent block -> all None, so v1-v7 verdicts skip the capacity
+    # gates cleanly in both directions.
+    cap = verdict.get("capacity")
+    out["serve_burn_rate_max"] = (cap or {}).get("burn_rate_max")
+    out["serve_headroom_rps"] = (cap or {}).get("headroom_rps")
+    out["serve_demand_shed_ratio_max"] = (
+        (cap or {}).get("demand_shed_ratio_max")
     )
     swap = verdict.get("swap")
     if swap is None:

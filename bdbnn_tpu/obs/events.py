@@ -232,6 +232,21 @@ The performance observatory (obs/roofline.py) adds one:
   append-only ``PERF_LEDGER.jsonl`` persist; what ``compare`` judges
   per-(layer, bucket, impl) and ``watch``/``summarize`` render)
 
+The capacity observatory (obs/capacity.py) adds one:
+
+- ``capacity``    — the capacity & demand plane's lifecycle
+  (serve/http.py stats pump), disambiguated by ``phase``: ``stats``
+  (one periodic tick: windowed offered rps, in-flight decisions, the
+  max per-key shed ratio, the saturation-headroom estimate, the last
+  utilization gauges — busy fraction / occupancy / queue share /
+  admission headroom — and the per-detector burn-rate table),
+  ``breach`` (a per-(priority, objective) error-budget detector fired
+  after warmup→debounce with BOTH burn windows over threshold: the
+  detector name, fast/slow burn rates, threshold — the breach episode
+  opens here) and ``recovered`` (the latched detector's fast window
+  dropped back under budget; the episode closes and lands in the
+  verdict's ``capacity`` block with its peak burn rate)
+
 New kinds must be registered in :data:`KNOWN_KINDS` — the
 ``event-schema`` checker (bdbnn_tpu/analysis/eventschema.py, wrapped
 as a tier-1 test by ``tests/test_events_schema.py``) AST-scans every
@@ -295,6 +310,7 @@ KNOWN_KINDS = frozenset(
         "trial",
         "analysis",
         "perf",
+        "capacity",
     }
 )
 
@@ -467,6 +483,7 @@ def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     canaries = [e for e in events if e.get("kind") == "canary"]
     shadows = [e for e in events if e.get("kind") == "shadow"]
     fleets = [e for e in events if e.get("kind") == "fleet"]
+    capacities = [e for e in events if e.get("kind") == "capacity"]
     return {
         "fleet_start": next(
             (e for e in fleets if e.get("phase") == "start"), None
@@ -543,6 +560,26 @@ def serve_digest(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             ),
             None,
         ),
+        # the capacity plane (obs/capacity.py): the LAST periodic tick
+        # (live headroom/burn gauges), every breach/recovery
+        # transition, and the full tick trail (the headroom-over-time
+        # timeline the flash-crowd acceptance reads)
+        "capacity_stats": next(
+            (
+                e for e in reversed(capacities)
+                if e.get("phase") == "stats"
+            ),
+            None,
+        ),
+        "capacity_stats_trail": [
+            e for e in capacities if e.get("phase") == "stats"
+        ],
+        "capacity_breaches": [
+            e for e in capacities if e.get("phase") == "breach"
+        ],
+        "capacity_recoveries": [
+            e for e in capacities if e.get("phase") == "recovered"
+        ],
     }
 
 

@@ -345,7 +345,8 @@ def _serving(events) -> Optional[Dict[str, Any]]:
                           "wall_s", "scenario", "per_priority",
                           "per_tenant", "fairness_ratio", "slo",
                           "replicas", "scaling", "swap", "attribution",
-                          "canary", "fleet", "fleet_attribution")
+                          "canary", "fleet", "fleet_attribution",
+                          "capacity")
             }
             if verdict
             else None
@@ -365,6 +366,8 @@ def _serving(events) -> Optional[Dict[str, Any]]:
         "replica_restarts": len(digest["replica_restarts"]),
         "canary_events": len(digest["canary_events"]),
         "shadow_mirrors": len(digest["shadow_mirrors"]),
+        "capacity_breaches": len(digest["capacity_breaches"]),
+        "capacity_recoveries": len(digest["capacity_recoveries"]),
     }
 
 
@@ -1210,6 +1213,85 @@ def summarize_run(path: str) -> Tuple[str, Dict[str, Any]]:
                         lines.append(
                             f"    slowest p{p}: #{wf.get('seq')} "
                             f"{wf.get('total_ms')}ms = {waterfall}"
+                        )
+            # the v8 capacity block (obs/capacity.py): the demand
+            # ledger's per-key rates, utilization gauges, the SLO
+            # burn-rate episodes and the saturation-headroom estimate
+            cap = sv.get("capacity")
+            if cap:
+                burn_max = cap.get("burn_rate_max")
+                headroom_rps = cap.get("headroom_rps")
+                shed_max = cap.get("demand_shed_ratio_max")
+                lines.append(
+                    "  capacity:"
+                    + (
+                        f" burn max {burn_max}"
+                        if burn_max is not None else " burn max -"
+                    )
+                    + (
+                        f" | headroom {headroom_rps} rps"
+                        if headroom_rps is not None else ""
+                    )
+                    + (
+                        f" | worst shed ratio {shed_max:.1%}"
+                        if shed_max is not None else ""
+                    )
+                )
+                demand = cap.get("demand") or {}
+                keys = demand.get("keys") or {}
+                if keys:
+                    lines.append(
+                        "    "
+                        + f"{'model|tenant|prio':<28}"
+                        + f"{'offered':>9}{'admit':>9}"
+                        + f"{'done':>9}{'shed':>9}"
+                    )
+                    for key in sorted(keys):
+                        row = keys[key]
+                        lines.append(
+                            "    "
+                            + f"{key:<28}"
+                            + f"{row.get('offered_rps', 0):>9}"
+                            + f"{row.get('admitted_rps', 0):>9}"
+                            + f"{row.get('completed_rps', 0):>9}"
+                            + f"{row.get('shed_rps', 0):>9}"
+                        )
+                budget = cap.get("slo_budget") or {}
+                for ep in budget.get("episodes") or []:
+                    t_end = ep.get("t_end")
+                    lines.append(
+                        f"    burn episode: {ep.get('detector')} "
+                        f"peak {ep.get('peak_burn_rate')} "
+                        + (
+                            f"({ep.get('t_end') - ep.get('t_start'):.1f}s)"
+                            if t_end is not None else "(still open)"
+                        )
+                    )
+                hr = cap.get("headroom") or {}
+                if hr.get("capacity_rps_est") is not None:
+                    tts = hr.get("seconds_to_saturation")
+                    lines.append(
+                        f"    est capacity {hr['capacity_rps_est']} rps"
+                        + (
+                            f" | saturates in {tts:.0f}s at current slope"
+                            if tts is not None else ""
+                        )
+                    )
+                # fleet-merged producer: per-host freshness + gates
+                flc = cap.get("fleet")
+                if flc:
+                    lines.append(
+                        f"    fleet: {flc.get('hosts_fresh')} fresh / "
+                        f"{flc.get('hosts_stale')} stale host(s)"
+                    )
+                    for label in sorted(flc.get("hosts") or {}):
+                        hb = (flc.get("hosts") or {})[label]
+                        lines.append(
+                            f"      {label}: "
+                            + ("STALE" if hb.get("stale") else "fresh")
+                            + f" | offered {hb.get('offered_rps')} rps"
+                            + f" | burn {hb.get('burn_rate_max')}"
+                            + f" | headroom {hb.get('headroom_rps')}"
                         )
     if tta:
         lines.append("time-to-accuracy (val top-1):")

@@ -183,6 +183,18 @@ def _serve_lines(events) -> List[str]:
                         else "no samples yet"
                     )
                 )
+        fcap = fleet_stats.get("capacity")
+        if fcap and fcap.get("hosts"):
+            # the fleet-merged capacity view: summed demand/headroom
+            # over FRESH hosts, worst burn rate across the fleet
+            merged = fcap.get("merged") or {}
+            lines.append(
+                f"capacity: fleet offered {merged.get('offered_rps')} "
+                f"rps | headroom {merged.get('headroom_rps')} rps | "
+                f"burn max {merged.get('burn_rate_max')} "
+                f"({fcap.get('hosts_fresh')} fresh / "
+                f"{fcap.get('hosts_stale')} stale)"
+            )
     if digest["fleet_drain"] and verdict is None:
         lines.append(
             f"!! fleet draining (signal "
@@ -380,6 +392,37 @@ def _serve_lines(events) -> List[str]:
                 if share is not None else ""
             )
         )
+    cap_stats = digest["capacity_stats"]
+    if cap_stats and verdict is None:
+        # the live capacity gauges (obs/capacity.py heartbeat): demand
+        # rate, in-flight, headroom estimate and the worst burn rate —
+        # WHILE the run serves
+        hr = cap_stats.get("headroom") or {}
+        burns = [
+            b
+            for row in (cap_stats.get("detectors") or {}).values()
+            for b in (row.get("burn_rate_fast"),
+                      row.get("burn_rate_slow"))
+            if b is not None
+        ]
+        lines.append(
+            f"capacity: offered {cap_stats.get('offered_rps')} rps | "
+            f"in-flight {cap_stats.get('in_flight')}"
+            + (
+                f" | headroom {hr.get('headroom_rps')} rps"
+                if hr.get("headroom_rps") is not None else ""
+            )
+            + (f" | burn max {max(burns)}" if burns else "")
+        )
+        latched = sorted(
+            name
+            for name, row in (cap_stats.get("detectors") or {}).items()
+            if row.get("latched")
+        )
+        if latched:
+            lines.append(
+                "!! SLO BUDGET BURNING: " + ", ".join(latched)
+            )
     if verdict:
         shed_rate = float(verdict.get("shed_rate") or 0.0)
         lines.append(
@@ -576,6 +619,26 @@ def _serve_lines(events) -> List[str]:
                 lines.append(
                     f"    slowest p{p}: #{wf.get('seq')} "
                     f"{wf.get('total_ms')}ms = {waterfall}"
+                )
+        cap = verdict.get("capacity")
+        if cap:
+            # the v8 capacity disposition: the three compare gates plus
+            # the budget's burn episodes
+            lines.append(
+                f"  capacity: burn max {cap.get('burn_rate_max')} | "
+                f"headroom {cap.get('headroom_rps')} rps | worst shed "
+                f"ratio {cap.get('demand_shed_ratio_max')}"
+            )
+            budget = cap.get("slo_budget") or {}
+            for ep in budget.get("episodes") or []:
+                t_end = ep.get("t_end")
+                lines.append(
+                    f"    burn episode: {ep.get('detector')} peak "
+                    f"{ep.get('peak_burn_rate')}"
+                    + (
+                        f" ({t_end - ep.get('t_start'):.1f}s)"
+                        if t_end is not None else " (still open)"
+                    )
                 )
     return lines
 

@@ -81,6 +81,17 @@ class TokenBucket:
             return True
         return False
 
+    def headroom(self) -> float:
+        """Current fill fraction (0..1) WITHOUT taking a token: the
+        refresh math of :meth:`try_take` applied read-only, so the
+        capacity plane (obs/capacity.py) can sample quota headroom
+        between requests without charging anyone."""
+        now = self._clock()
+        return (
+            min(self.burst, self.tokens + (now - self._t_last) * self.rate)
+            / self.burst
+        )
+
 
 def parse_quota(spec: str) -> Tuple[float, float]:
     """``"RATE"`` or ``"RATE:BURST"`` -> (rate, burst); burst defaults
@@ -207,6 +218,18 @@ class AdmissionController:
         failure in the ledger."""
         with self._lock:
             self._tenant_counts(tenant)["rejected"] += 1
+
+    def token_headroom(self) -> Optional[float]:
+        """Mean quota-headroom fraction across the tenants seen so far
+        (1.0 = every bucket full, 0.0 = every tenant exhausted) — the
+        admission gauge the capacity plane's UtilizationWindows
+        samples. None before any tenant has been admitted: no buckets
+        is "nothing to measure", not "full headroom"."""
+        with self._lock:
+            fracs = [b.headroom() for b in self._buckets.values()]
+        if not fracs:
+            return None
+        return round(sum(fracs) / len(fracs), 4)
 
     # -- lifecycle / reporting -----------------------------------------
 

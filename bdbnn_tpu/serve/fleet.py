@@ -93,6 +93,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from bdbnn_tpu.obs.capacity import FleetCapacityWindows
 from bdbnn_tpu.obs.events import jsonsafe
 from bdbnn_tpu.obs.health import DetectorState
 from bdbnn_tpu.obs.rtrace import (
@@ -344,6 +345,13 @@ class FleetRouter:
             window=int(scrape_window),
             stale_after=int(scrape_stale_after),
         )
+        # the fleet capacity merge (obs/capacity.py): per-host scraped
+        # capacity blocks under the SAME staleness discipline as the
+        # rtrace windows above — internally locked, fed only by the
+        # scrape pump
+        self.capacity = FleetCapacityWindows(
+            stale_after=int(scrape_stale_after),
+        )
         # ONE reentrant lock for the whole router (host table included):
         # reentrancy makes an accidental nested acquire harmless, and
         # the condition below shares it so drain's inflight-zero wait
@@ -357,8 +365,11 @@ class FleetRouter:
             )
             for i, (h, p) in enumerate(hosts)
         ]
-        # guarded-by: _lock: _inflight, _rr, _counts, _lats, _unrouteable, _shed_draining, _t_started, _t_drained, _swap, _swap_thread
+        # guarded-by: _lock: _inflight, _rr, _counts, _lats, _arrival_stamps, _unrouteable, _shed_draining, _t_started, _t_drained, _swap, _swap_thread
         self._inflight = 0
+        # observed proxy arrival stamps: the MEASURED offered rate
+        # serve-mode fleet verdicts report (never a config figure)
+        self._arrival_stamps: List[float] = []
         self._rr = 0
         self._counts: List[Dict[str, int]] = [
             {"submitted": 0, "completed": 0, "failed": 0,
@@ -889,6 +900,7 @@ class FleetRouter:
                 # request — warmup idle must not dilute throughput
                 self._t_started = time.perf_counter()
             self._counts[priority]["submitted"] += 1
+            self._arrival_stamps.append(time.perf_counter())
             if self._draining.is_set():
                 self._counts[priority]["shed_draining"] += 1
                 self._shed_draining += 1
@@ -1118,14 +1130,23 @@ class FleetRouter:
                     timeout=self.scrape_timeout_s,
                 )
                 block = None
+                cap_block = None
                 if status == 200:
-                    block = (json.loads(rbody) or {}).get("rtrace")
+                    payload = json.loads(rbody) or {}
+                    block = payload.get("rtrace")
+                    cap_block = payload.get("capacity")
                 if isinstance(block, dict):
                     self.scrape.record(h.label, block)
                 else:
                     self.scrape.record_failure(h.label)
+                # the capacity merge follows the same discipline but
+                # keeps its own staleness book: a host serving rtrace
+                # without a capacity block (pre-v8) goes stale HERE
+                # without poisoning the rtrace windows, and vice versa
+                self.capacity.record(h.label, cap_block)
             except Exception:
                 self.scrape.record_failure(h.label)
+                self.capacity.record_failure(h.label)
 
     def stats(self) -> Dict[str, Any]:
         hosts: Dict[str, Any] = {}
@@ -1155,6 +1176,10 @@ class FleetRouter:
             self.tracer.stats() if self.tracer is not None else None
         )
         out["host_windows"] = self.scrape.snapshot()
+        # the fleet-merged capacity view: per-host demand/headroom/burn
+        # summaries + the merged-over-fresh-hosts totals — what the
+        # router's own /statsz serves one level up
+        out["capacity"] = self.capacity.snapshot()
         return jsonsafe(out)
 
     def accounting(self) -> Dict[str, Any]:
@@ -1167,13 +1192,37 @@ class FleetRouter:
                 t_end - self._t_started
                 if self._t_started is not None else 0.0
             )
+            stamps = self._arrival_stamps
+            measured_rate = None
+            if len(stamps) >= 2 and stamps[-1] > stamps[0]:
+                # (n-1) inter-arrival gaps over their observed span:
+                # what actually hit the router, not a config knob
+                measured_rate = round(
+                    (len(stamps) - 1) / (stamps[-1] - stamps[0]), 4
+                )
             return {
                 "wall_s": wall_s,
                 "latencies_ms_by_priority": [
                     sorted(l) for l in self._lats
                 ],
                 "counts_by_priority": [dict(c) for c in self._counts],
+                "measured_rate_rps": measured_rate,
             }
+
+    def capacity_block(self) -> Dict[str, Any]:
+        """The fleet verdict's v8 ``capacity`` block: the per-host
+        summaries + the merged-over-fresh-hosts view, with the three
+        flat gates ``compare`` judges (``burn_rate_max``,
+        ``headroom_rps``, ``demand_shed_ratio_max``) at the top level
+        — same contract as a single host's block."""
+        snap = self.capacity.snapshot()
+        merged = snap["merged"]
+        return {
+            "fleet": snap,
+            "burn_rate_max": merged["burn_rate_max"],
+            "headroom_rps": merged["headroom_rps"],
+            "demand_shed_ratio_max": merged["demand_shed_ratio_max"],
+        }
 
     def fleet_block(
         self, client: Optional[Dict[str, Any]] = None
@@ -1268,6 +1317,7 @@ def fleet_slo_verdict(
     client: Optional[Dict[str, Any]] = None,
     slo_p99_ms: float = 0.0,
     fleet_attribution: Optional[Dict[str, Any]] = None,
+    capacity: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Build the v7 verdict from the router's ledger: the same
     per-priority skeleton as the HTTP front end's verdict (so
@@ -1338,6 +1388,7 @@ def fleet_slo_verdict(
         slo=slo,
         fleet=fleet,
         fleet_attribution=fleet_attribution,
+        capacity=capacity,
     )
 
 
@@ -1601,11 +1652,18 @@ def _serve_fleet_body(cfg, handler, on_arrival=None) -> Dict[str, Any]:
         pump.join(timeout=5.0)
 
     fleet = router.fleet_block(client=client_raw)
+    accounting = router.accounting()
     verdict = fleet_slo_verdict(
-        router.accounting(),
+        accounting,
         fleet,
         scenario=cfg.scenario or "fleet",
-        rate=cfg.rate if cfg.scenario else None,
+        # scenario mode records the SCHEDULED rate; serve mode records
+        # the MEASURED offered rate from observed arrival stamps —
+        # cfg.rate there would fabricate a figure nothing measured
+        rate=(
+            cfg.rate if cfg.scenario
+            else accounting["measured_rate_rps"]
+        ),
         seed=cfg.seed,
         provenance={
             "hosts": list(cfg.hosts),
@@ -1623,6 +1681,7 @@ def _serve_fleet_body(cfg, handler, on_arrival=None) -> Dict[str, Any]:
         fleet_attribution=(
             tracer.attribution() if tracer is not None else None
         ),
+        capacity=router.capacity_block(),
     )
     events.emit("serve", phase="verdict", **verdict)
     events.emit("fleet", phase="stop", host=host, port=port)

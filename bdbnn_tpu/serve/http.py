@@ -58,6 +58,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from bdbnn_tpu.obs.capacity import CapacityPlane
 from bdbnn_tpu.obs.events import jsonsafe
 from bdbnn_tpu.obs.rtrace import (
     STAGE_HEADER,
@@ -138,9 +139,21 @@ class HttpFrontEnd:
         tracer: Optional[Any] = None,
         canary: Optional[Any] = None,
         server_id: Optional[str] = None,
+        capacity: Optional[CapacityPlane] = None,
     ):
         self.batcher = batcher
         self.admission = admission
+        # capacity observatory (obs/capacity.py): every request feeds
+        # the per-(model, tenant, priority) demand ledger and — on its
+        # terminal disposition — the SLO budget plane. Always present
+        # so the feed sites never branch; the orchestration passes a
+        # plane configured with the run's objectives, a bare default
+        # otherwise (demand + utilization still measured, no
+        # detectors armed).
+        self.capacity = (
+            capacity if capacity is not None
+            else CapacityPlane(priorities=batcher.priorities)
+        )
         # canary monitor (serve/canary.py): when wired, every served
         # request's (priority, latency, answered-by version) feeds the
         # per-cohort latency windows the rollout verdict judges. The
@@ -202,6 +215,10 @@ class HttpFrontEnd:
         self._lat_by_priority: List[List[float]] = [
             [] for _ in range(batcher.priorities)
         ]
+        # observed /v1/predict arrival stamps (perf_counter): the
+        # MEASURED offered-rate figure serve-mode verdicts report —
+        # derived from what actually arrived, never from a config knob
+        self._arrival_stamps: List[float] = []
         self._counts_by_priority: List[Dict[str, int]] = [
             {"submitted": 0, "completed": 0, "failed": 0,
              "rejected": 0, "shed_draining": 0, "shed_over_quota": 0,
@@ -583,10 +600,20 @@ class HttpFrontEnd:
     ) -> None:
         counts = self._counts_by_priority[priority]
         counts["submitted"] += 1
+        self._arrival_stamps.append(t0)
+        # demand-ledger key: the model the CLIENT asked for (resolved
+        # or not — a request 404ing on an unknown model is still
+        # demand for it), so offered and its disposition always land
+        # under the same key and the ledger identity holds per key
+        ledger_model = headers.get("x-model") or DEFAULT_MODEL
+        cap = self.capacity
+        cap.ledger.offered(ledger_model, tenant, priority)
         decision = self.admission.admit(tenant, trace=trace)
         if decision == DRAINING:
             self._abort_trace(trace)
             counts["shed_draining"] += 1
+            cap.ledger.shed(ledger_model, tenant, priority)
+            cap.budget.feed(priority, shed=True)
             self._respond(
                 writer, 503,
                 {"error": "draining", "tenant": tenant},
@@ -596,6 +623,10 @@ class HttpFrontEnd:
         if decision == OVER_QUOTA:
             self._abort_trace(trace)
             counts["shed_over_quota"] += 1
+            # the tenant's own budget ran out — `rejected` in the
+            # demand ledger (with 400s/404s), and NOT fed to the shed
+            # SLO: a 429 is the quota working, not capacity failing
+            cap.ledger.rejected(ledger_model, tenant, priority)
             self._respond(
                 writer, 429,
                 {"error": "over_quota", "tenant": tenant},
@@ -612,6 +643,7 @@ class HttpFrontEnd:
             self._abort_trace(trace)
             counts["rejected"] += 1
             self.admission.record_rejected(tenant)
+            cap.ledger.rejected(ledger_model, tenant, priority)
             self._respond(writer, 404, {
                 "error": "multi-model routing disabled "
                 "(start serve-http with --resident-models >= 2)",
@@ -633,6 +665,7 @@ class HttpFrontEnd:
                 self._abort_trace(trace)
                 counts["rejected"] += 1
                 self.admission.record_rejected(tenant)
+                cap.ledger.rejected(ledger_model, tenant, priority)
                 self._respond(writer, 404, {
                     "error": f"unknown model: {e.args[0] if e.args else raw_model}",
                     "model": raw_model,
@@ -649,6 +682,7 @@ class HttpFrontEnd:
             self._abort_trace(trace)
             counts["rejected"] += 1
             self.admission.record_rejected(tenant)
+            cap.ledger.rejected(ledger_model, tenant, priority)
             self._respond(
                 writer, 400, {"error": f"undecodable body: {e}"}
             )
@@ -663,6 +697,8 @@ class HttpFrontEnd:
             self._abort_trace(trace)
             self.admission.record_shed(tenant)
             counts[_shed_key(e.reason)] += 1
+            cap.ledger.shed(ledger_model, tenant, priority)
+            cap.budget.feed(priority, shed=True)
             self._respond(
                 writer, 503,
                 {"error": e.reason, "tenant": tenant},
@@ -681,6 +717,12 @@ class HttpFrontEnd:
             self._abort_trace(trace)
             self.admission.record_shed(tenant)
             counts[_shed_key(e.reason)] += 1
+            # a future-delivered shed never really entered service —
+            # the ledger's entry disposition is `shed`, same as a
+            # submit-time shed (admitted is bumped only at terminal
+            # served/failed, so identity never double-counts this)
+            cap.ledger.shed(ledger_model, tenant, priority)
+            cap.budget.feed(priority, shed=True)
             self._respond(
                 writer, 503,
                 {"error": e.reason, "tenant": tenant},
@@ -691,6 +733,8 @@ class HttpFrontEnd:
             self._abort_trace(trace)
             self.admission.record_failed(tenant)
             counts["failed"] += 1
+            cap.ledger.admitted(ledger_model, tenant, priority)
+            cap.ledger.failed(ledger_model, tenant, priority)
             self._respond(
                 writer, 500, {"error": f"inference failed: {e}"}
             )
@@ -699,6 +743,9 @@ class HttpFrontEnd:
         self._lat_by_priority[priority].append(lat_ms)
         counts["completed"] += 1
         self.admission.record_completed(tenant)
+        cap.ledger.admitted(ledger_model, tenant, priority)
+        cap.ledger.completed(ledger_model, tenant, priority)
+        cap.budget.feed(priority, latency_ms=lat_ms)
         if self.canary is not None:
             # cohort truth is who ANSWERED: the version label rides
             # the request future (obs/rtrace.py), so a canary-assigned
@@ -798,6 +845,10 @@ class HttpFrontEnd:
             "canary": (
                 self.canary.live() if self.canary is not None else None
             ),
+            # the live capacity block (obs/capacity.py): demand table,
+            # utilization gauges, burn-rate peek + headroom estimate —
+            # what the fleet router scrapes and merges
+            "capacity": self.capacity.live_block(),
         })
 
     def accounting(self) -> Dict[str, Any]:
@@ -807,6 +858,15 @@ class HttpFrontEnd:
         wall_s = (
             t_end - self._t_started if self._t_started is not None else 0.0
         )
+        stamps = self._arrival_stamps
+        measured_rate = None
+        if len(stamps) >= 2:
+            span = stamps[-1] - stamps[0]
+            if span > 0:
+                # offered rate over the observed arrival span: (n-1)
+                # inter-arrival gaps over their total duration — what
+                # actually hit the socket, not what any config claims
+                measured_rate = round((len(stamps) - 1) / span, 4)
         return {
             "wall_s": wall_s,
             "latencies_ms_by_priority": [
@@ -817,6 +877,7 @@ class HttpFrontEnd:
             ],
             "completed_by_model": dict(self._completed_by_model),
             "requests_seen": self._requests_seen,
+            "measured_rate_rps": measured_rate,
         }
 
 
@@ -1169,6 +1230,32 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
             on_event=lambda kind, **f: events.emit(kind, **f),
         )
 
+    # the capacity observatory (obs/capacity.py): burn-rate windows
+    # scale with the stats cadence — the pump is the only detector
+    # clock, so ~5 ticks of fast window / ~30 of slow keeps the
+    # warmup->debounce->hysteresis semantics stable whether the pump
+    # runs at the production default or a test's tight interval
+    from bdbnn_tpu.obs.capacity import CapacityPlane
+
+    cap_fast_s = max(5 * cfg.stats_interval_s, 1.0)
+    cap_slow_s = max(30 * cfg.stats_interval_s, 3 * cap_fast_s)
+    cap_window_s = max(20 * cfg.stats_interval_s, 2.0)
+    capacity_plane = CapacityPlane(
+        slo_p99_ms=cfg.slo_p99_ms,
+        slo_shed_rate=cfg.slo_shed_rate,
+        priorities=cfg.priorities,
+        window_s=cap_window_s,
+        fast_window_s=cap_fast_s,
+        slow_window_s=cap_slow_s,
+        # busy-fraction samples arrive once per pump tick; sizing the
+        # gauge window to span the SAME wall-clock stretch as the
+        # demand window keeps capacity_rps_est (completed over busy
+        # mean) and offered_rps measured over the same interval — a
+        # whole-run busy mean would dilute the estimate and hide the
+        # negative headroom a flash crowd must expose
+        util_window=max(10, int(round(cap_window_s / cfg.stats_interval_s))),
+    )
+
     front = HttpFrontEnd(
         batcher,
         admission,
@@ -1182,6 +1269,7 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
         tracer=tracer,
         canary=canary_monitor,
         server_id=cfg.server_id or None,
+        capacity=capacity_plane,
     )
     host, port = front.start()
     events.emit(
@@ -1303,6 +1391,23 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
         return single_engine_resident_block(engine.residency())
 
     resident_now = _resident_snapshot()
+    # per-bucket residency bytes are static after warmup: captured once
+    # into the utilization windows (single-engine: the engine's own
+    # report; pooled: the cache summary — per-replica bytes live in
+    # the resident block already)
+    if cfg.pooled:
+        capacity_plane.utilization.set_residency(
+            {
+                "resident_bytes_per_model_max": resident_now[
+                    "bytes_per_model_max"
+                ],
+                "models": len(resident_now["models"]),
+                "replicas": resident_now["replicas"],
+            }
+            if resident_now is not None else None
+        )
+    else:
+        capacity_plane.utilization.set_residency(engine.residency())
     if resident_now is not None:
         events.emit(
             "memory",
@@ -1355,6 +1460,47 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
                 # the live stage histograms: `watch` renders the
                 # per-stage p99 waterfall from this heartbeat
                 events.emit("rtrace", phase="stats", **tracer.stats())
+            # capacity tick: sample the utilization gauges from the
+            # snapshots already in hand, then advance the burn-rate
+            # detectors — the pump is the ONLY detector clock
+            busy_fraction = None
+            if pool is not None:
+                reps = pool.stats()["replicas"]
+                if reps:
+                    busy_fraction = sum(
+                        1 for r in reps if r["busy"]
+                    ) / len(reps)
+            rtr = s.get("rtrace") or {}
+            capacity_plane.sample(
+                busy_fraction=busy_fraction,
+                occupancy=s["batcher"].get("mean_occupancy"),
+                queue_share=rtr.get("queue_share"),
+                admission_headroom=admission.token_headroom(),
+            )
+            cap_tick = capacity_plane.evaluate()
+            for row in cap_tick["fired"]:
+                events.emit("capacity", phase="breach", **row)
+            for row in cap_tick["recovered"]:
+                events.emit("capacity", phase="recovered", **row)
+            # re-snapshot AFTER sampling so the emitted gauges and the
+            # headroom estimate reflect THIS tick, not the previous one
+            cap_live = capacity_plane.live_block()
+            events.emit(
+                "capacity",
+                phase="stats",
+                offered_rps=cap_live["demand"]["offered_rps"],
+                in_flight=cap_live["demand"]["in_flight_decisions"],
+                demand_shed_ratio_max=cap_live["demand"][
+                    "demand_shed_ratio_max"
+                ],
+                headroom=cap_live["headroom"],
+                utilization={
+                    g: cap_live["utilization"][g]["last"]
+                    for g in ("busy_fraction", "occupancy",
+                              "queue_share", "admission_headroom")
+                },
+                detectors=cap_tick["detectors"],
+            )
 
     pump = threading.Thread(target=stats_pump, daemon=True)
     pump.start()
@@ -1517,14 +1663,20 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
             ),
             "step_ms_delta_pct": None,
         }
+    accounting = front.accounting()
     verdict = http_slo_verdict(
-        front.accounting(),
+        accounting,
         batcher.stats(),
         admission_stats,
         scenario=cfg.scenario or "serve",
-        # serve mode runs no load generator: recording cfg.rate there
+        # scenario mode records the SCHEDULED rate (the knob the bench
+        # was asked to drive); serve mode records the MEASURED offered
+        # rate derived from observed arrival stamps — cfg.rate there
         # would fabricate an offered-load figure nothing measured
-        rate=cfg.rate if cfg.scenario else None,
+        rate=(
+            cfg.rate if cfg.scenario
+            else accounting["measured_rate_rps"]
+        ),
         seed=cfg.seed,
         provenance=_serve_provenance(
             artifact_dir, engine, prov, recipe, manifest
@@ -1547,6 +1699,7 @@ def _serve_http_body(cfg, handler, degrade=None) -> Dict[str, Any]:
         canary=(
             admin.canary_report() if admin is not None else None
         ),
+        capacity=capacity_plane.verdict_block(),
     )
     events.emit("serve", phase="verdict", **verdict)
     events.emit("http", phase="stop", host=host, port=port)

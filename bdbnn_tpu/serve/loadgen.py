@@ -102,7 +102,22 @@ VERDICT_NAME = "verdict.json"
 # ``serve_fleet_p99_network_ms`` / ``serve_fleet_retry_hop_share`` /
 # ``serve_fleet_stage_spread_max`` gates. Null when router tracing
 # is off, so v1-v6 consumers keep working unchanged.
-VERDICT_SCHEMA_VERSION = 7
+# v8: the ``capacity`` block (obs/capacity.py) — the capacity &
+# demand observatory: the per-(model, tenant, priority) demand table
+# with the ledger identity (offered == admitted + rejected + shed),
+# the utilization windows (replica busy fraction, batch occupancy,
+# rtrace queue share, admission token headroom, residency bytes),
+# the SLO error-budget plane (per-priority burn-rate peaks over fast
+# + slow windows, breach episodes from --slo-p99-ms /
+# --slo-shed-rate) and the saturation-headroom estimate — the
+# sources of ``compare``'s ``serve_burn_rate_max`` /
+# ``serve_headroom_rps`` / ``serve_demand_shed_ratio_max`` gates.
+# Also in v8: serve-mode (no scenario) verdicts now record the
+# MEASURED offered rate derived from observed arrival stamps in
+# ``rate_rps`` — previously null; scenario/bench verdicts keep the
+# scheduled rate. Null ``capacity`` on pre-v8 producers, so v1-v7
+# consumers keep working unchanged.
+VERDICT_SCHEMA_VERSION = 8
 
 
 def percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -716,6 +731,7 @@ def slo_verdict(
     canary: Optional[Dict[str, Any]] = None,
     fleet: Optional[Dict[str, Any]] = None,
     fleet_attribution: Optional[Dict[str, Any]] = None,
+    capacity: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble the deterministic strict-JSON SLO verdict.
 
@@ -759,7 +775,14 @@ def slo_verdict(
     identity and the slowest-K exemplars naming host and stage — the
     source of ``compare``'s ``serve_fleet_p99_network_ms`` /
     ``serve_fleet_retry_hop_share`` / ``serve_fleet_stage_spread_max``
-    gates. Null when router tracing is off."""
+    gates. Null when router tracing is off. The capacity observatory
+    (obs/capacity.py) adds the v8 ``capacity`` block: the per-(model,
+    tenant, priority) demand table with the ledger identity, the
+    utilization windows, burn-rate peaks + breach episodes per
+    priority and the saturation-headroom estimate — the source of
+    ``compare``'s ``serve_burn_rate_max`` / ``serve_headroom_rps`` /
+    ``serve_demand_shed_ratio_max`` gates. Null when no capacity
+    plane ran (pre-v8 producers and the in-process bench)."""
     lats = raw["latencies_ms"]
     wall = max(raw["wall_s"], 1e-9)
     submitted = max(raw["submitted"], 1)
@@ -802,6 +825,7 @@ def slo_verdict(
         "canary": canary,
         "fleet": fleet,
         "fleet_attribution": fleet_attribution,
+        "capacity": capacity,
         # bucket keys as strings: the verdict must survive a JSON
         # round trip unchanged (int dict keys would silently stringify)
         "warmup_compile_s": (
@@ -836,6 +860,7 @@ def http_slo_verdict(
     packed: Optional[Dict[str, Any]] = None,
     attribution: Optional[Dict[str, Any]] = None,
     canary: Optional[Dict[str, Any]] = None,
+    capacity: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Build the v2 verdict from the HTTP front end's request ledger
     (:meth:`serve.http.HttpFrontEnd.accounting`), the batcher's
@@ -928,6 +953,7 @@ def http_slo_verdict(
         packed=packed,
         attribution=attribution,
         canary=canary,
+        capacity=capacity,
     )
 
 

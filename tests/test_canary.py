@@ -1158,7 +1158,7 @@ class TestCanaryRollbackEndToEnd:
         by = v["swap"]["answered_by"]
         assert sum(by.values()) == v["requests_completed"]
         assert by.get("v0002", 0) > 0
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
 
     def test_pool_restored_to_vn(self, rollback_run):
         ps = rollback_run["pool_stats"]
@@ -1307,7 +1307,7 @@ class TestCanaryPromoteEndToEnd:
         by = v["swap"]["answered_by"]
         assert set(by) == {"v0001", "v0002"}
         assert sum(by.values()) == v["requests_completed"]
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
 
     def test_episode_consumed_by_watch_summarize_compare(
         self, promote_run

@@ -1,0 +1,665 @@
+"""Capacity & demand observatory tests (bdbnn_tpu/obs/capacity.py +
+its serving-stack wiring).
+
+- the demand ledger's identity ``offered == admitted + rejected +
+  shed`` under concurrent feeders, plus the per-key/rollup reporting
+- the saturation-headroom math's None-propagation discipline (an
+  autoscaler must never act on a fabricated estimate)
+- the SLO burn-rate plane: per-detector synthetic streams fire exactly
+  their own breach (a bulk-class shed storm never torches the premium
+  class's budget), warmup -> debounce -> hysteresis via the shared
+  DetectorState, and ``peek`` never ticking the machines (a fast
+  ``/statsz`` scraper must not accelerate the debounce clock)
+- the fleet merge excluding stale hosts (a wedged host's frozen
+  numbers never feed the merged view)
+- the live ``/statsz`` capacity block over real sockets, and the
+  measured-offered-rate accounting fix (serve-mode verdicts record
+  the observed arrival rate, never null, never fabricated)
+- THE acceptance e2e: a flash crowd against a 2-replica pool fires
+  the bulk class's shed burn-rate detector while the premium class's
+  budget stays intact, the headroom estimate goes negative during the
+  burst, the episode renders in watch/summarize, and ``compare``
+  clean-vs-doctored exits 3 on ``serve_burn_rate_max``.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from bdbnn_tpu.obs.capacity import (
+    BURN_RATE_CAP,
+    CapacityPlane,
+    DemandLedger,
+    FleetCapacityWindows,
+    SLOBudget,
+    UtilizationWindows,
+    _burn,
+    demand_key,
+    saturation_headroom,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# the demand ledger
+# ---------------------------------------------------------------------------
+
+
+class TestDemandLedger:
+    def test_identity_holds_under_concurrent_feeders(self):
+        """Many threads hammering offered + a disposition on shared
+        keys: the per-key identity ``offered == admitted + rejected +
+        shed`` must hold exactly at quiescence — the counters are one
+        lock, not per-counter races."""
+        ledger = DemandLedger(window_s=60.0)
+        keys = [("m0", "bulk", 2), ("m0", "premium", 0),
+                ("m1", "bulk", 1)]
+        per_thread = 200
+
+        def feeder(i):
+            model, tenant, p = keys[i % len(keys)]
+            for j in range(per_thread):
+                ledger.offered(model, tenant, p)
+                if j % 3 == 0:
+                    ledger.shed(model, tenant, p)
+                elif j % 3 == 1:
+                    ledger.rejected(model, tenant, p)
+                else:
+                    ledger.admitted(model, tenant, p)
+                    ledger.completed(model, tenant, p)
+
+        threads = [
+            threading.Thread(target=feeder, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = ledger.snapshot()
+        assert snap["identity_ok"] is True
+        assert snap["in_flight_decisions"] == 0
+        total_offered = sum(
+            row["offered"] for row in snap["keys"].values()
+        )
+        assert total_offered == 6 * per_thread
+        for row in snap["keys"].values():
+            assert row["identity_delta"] == 0
+            assert row["offered"] == (
+                row["admitted"] + row["rejected"] + row["shed"]
+            )
+
+    def test_in_flight_delta_is_live_gauge(self):
+        """`admitted` lands only at the terminal, so the identity
+        delta counts requests still queued/computing — then returns to
+        zero when they finish."""
+        ledger = DemandLedger(window_s=60.0)
+        ledger.offered("m", "t", 0)
+        ledger.offered("m", "t", 0)
+        snap = ledger.snapshot()
+        assert snap["in_flight_decisions"] == 2
+        assert snap["identity_ok"] is False  # mid-decision, not torn
+        ledger.admitted("m", "t", 0)
+        ledger.completed("m", "t", 0)
+        ledger.admitted("m", "t", 0)
+        ledger.failed("m", "t", 0)
+        snap = ledger.snapshot()
+        assert snap["in_flight_decisions"] == 0
+        assert snap["identity_ok"] is True
+        row = snap["keys"][demand_key("m", "t", 0)]
+        assert row["completed"] == 1 and row["failed"] == 1
+
+    def test_rps_uses_elapsed_span_not_full_window(self):
+        """A run younger than the window reports rates over its actual
+        age — a 2-second-old run over a 30s window must not dilute
+        every rate toward zero."""
+        clk = FakeClock()
+        ledger = DemandLedger(window_s=30.0, clock=clk)
+        for _ in range(20):
+            ledger.offered("m", "t", 0)
+        clk.tick(2.0)
+        snap = ledger.snapshot()
+        row = snap["keys"][demand_key("m", "t", 0)]
+        assert row["offered_rps"] == pytest.approx(10.0)
+
+    def test_rollups_and_shed_ratio_max(self):
+        ledger = DemandLedger(window_s=60.0)
+        for _ in range(4):
+            ledger.offered("m0", "bulk", 2)
+            ledger.shed("m0", "bulk", 2)
+        ledger.offered("m0", "premium", 0)
+        ledger.admitted("m0", "premium", 0)
+        ledger.completed("m0", "premium", 0)
+        snap = ledger.snapshot()
+        assert snap["by_model"]["m0"]["offered"] == 5
+        assert snap["by_tenant"]["bulk"]["shed"] == 4
+        assert snap["by_tenant"]["premium"]["shed"] == 0
+        # worst per-key shed ratio: bulk's 4/4, not the aggregate 4/5
+        assert snap["demand_shed_ratio_max"] == pytest.approx(1.0)
+
+    def test_offered_slope_needs_history(self):
+        clk = FakeClock()
+        ledger = DemandLedger(window_s=10.0, clock=clk)
+        ledger.offered("m", "t", 0)
+        # only the newest half has stamps -> no slope yet
+        assert ledger.offered_slope_rps_per_s() is None
+        clk.tick(6.0)
+        for _ in range(30):
+            ledger.offered("m", "t", 0)
+        # old half: 1 stamp, new half: 30 -> rising demand
+        slope = ledger.offered_slope_rps_per_s()
+        assert slope is not None and slope > 0
+
+
+# ---------------------------------------------------------------------------
+# utilization windows + headroom math
+# ---------------------------------------------------------------------------
+
+
+class TestUtilizationWindows:
+    def test_none_and_nonfinite_skipped_unknown_raises(self):
+        u = UtilizationWindows(window=4)
+        u.sample(busy_fraction=0.5, occupancy=None,
+                 queue_share=float("nan"))
+        u.sample(busy_fraction=1.0)
+        with pytest.raises(ValueError, match="unknown"):
+            u.sample(cpu_temperature=99.0)
+        snap = u.snapshot()
+        assert snap["busy_fraction"] == {
+            "last": 1.0, "mean": 0.75, "n": 2,
+        }
+        assert snap["occupancy"]["last"] is None
+        assert snap["queue_share"]["n"] == 0
+
+    def test_residency_block_reported(self):
+        u = UtilizationWindows()
+        assert u.snapshot()["residency"] is None
+        u.set_residency({"resident_bytes": 1024})
+        assert u.snapshot()["residency"] == {"resident_bytes": 1024}
+
+
+class TestSaturationHeadroom:
+    def test_negative_exactly_when_demand_exceeds_capacity(self):
+        h = saturation_headroom(
+            offered_rps=500.0, completed_rps=200.0, busy_fraction=1.0,
+        )
+        assert h["capacity_rps_est"] == pytest.approx(200.0)
+        assert h["headroom_rps"] == pytest.approx(-300.0)
+        assert h["seconds_to_saturation"] is None  # already saturated
+
+    def test_seconds_to_saturation_at_slope(self):
+        h = saturation_headroom(
+            offered_rps=100.0, completed_rps=100.0, busy_fraction=0.5,
+            slope_rps_per_s=10.0,
+        )
+        assert h["capacity_rps_est"] == pytest.approx(200.0)
+        assert h["headroom_rps"] == pytest.approx(100.0)
+        assert h["seconds_to_saturation"] == pytest.approx(10.0)
+
+    def test_unmeasurable_inputs_propagate_none(self):
+        # busy fraction below the noise floor -> no capacity estimate,
+        # no headroom, never a fabricated figure
+        h = saturation_headroom(
+            offered_rps=100.0, completed_rps=50.0, busy_fraction=0.001,
+        )
+        assert h["capacity_rps_est"] is None
+        assert h["headroom_rps"] is None
+        h = saturation_headroom(
+            offered_rps=None, completed_rps=50.0, busy_fraction=0.5,
+        )
+        assert h["capacity_rps_est"] is not None
+        assert h["headroom_rps"] is None
+
+
+class TestBurnMath:
+    def test_burn_semantics(self):
+        assert _burn(0, 0, 0.01) is None  # empty window: not measured
+        assert _burn(0, 100, 0.01) == 0.0
+        assert _burn(1, 100, 0.01) == pytest.approx(1.0)
+        assert _burn(5, 100, 0.01) == pytest.approx(5.0)
+        # zero budget: any badness is the cap, never inf
+        assert _burn(1, 100, 0.0) == BURN_RATE_CAP
+        assert _burn(0, 100, 0.0) == 0.0
+        # cap keeps every figure finite JSON
+        assert _burn(100, 100, 1e-9) == BURN_RATE_CAP
+
+
+# ---------------------------------------------------------------------------
+# the SLO budget plane
+# ---------------------------------------------------------------------------
+
+
+def _budget(clk, **kw):
+    kw.setdefault("slo_p99_ms", 100.0)
+    kw.setdefault("slo_shed_rate", 0.1)
+    kw.setdefault("priorities", 3)
+    kw.setdefault("fast_window_s", 2.0)
+    kw.setdefault("slow_window_s", 6.0)
+    return SLOBudget(clock=clk, **kw)
+
+
+class TestSLOBudget:
+    def test_objectives_gate_on_knobs(self):
+        clk = FakeClock()
+        assert _budget(clk).objectives() == ("latency", "shed")
+        assert _budget(clk, slo_shed_rate=0.0).objectives() == (
+            "latency",
+        )
+        assert _budget(
+            clk, slo_p99_ms=0.0, slo_shed_rate=0.0
+        ).objectives() == ()
+
+    def test_window_validation(self):
+        with pytest.raises(ValueError, match="fast_window_s"):
+            _budget(FakeClock(), fast_window_s=5.0, slow_window_s=2.0)
+
+    def test_each_detector_fires_exactly_its_own_breach(self):
+        """Synthetic per-priority streams: p2 sheds hard, p0 completes
+        fast, p1 completes slow. Only p2:shed and p1:latency fire —
+        the bulk storm never touches the premium budget, and neither
+        breach leaks across objectives."""
+        clk = FakeClock()
+        budget = _budget(clk)
+        fired = []
+        for _ in range(8):  # warmup 2 + debounce 2 + slack
+            for _ in range(20):
+                budget.feed(0, latency_ms=5.0)       # premium: healthy
+                budget.feed(1, latency_ms=500.0)     # over the target
+                budget.feed(2, shed=True)            # the shed storm
+            tick = budget.evaluate()
+            fired += [row["detector"] for row in tick["fired"]]
+            clk.tick(1.0)
+        assert sorted(fired) == ["p1:latency", "p2:shed"]
+        snap = budget.snapshot()
+        assert snap["breaches"] == 2
+        peaks = snap["burn_rate_peaks"]
+        assert peaks["p2:shed"] > 1.0
+        assert peaks.get("p0:latency", 0.0) <= 1.0
+        assert peaks.get("p0:shed", 0.0) == 0.0
+
+    def test_warmup_and_debounce_discipline(self):
+        """A persistent breach fires exactly at tick warmup+debounce,
+        then latches (no refire while breaching)."""
+        clk = FakeClock()
+        budget = _budget(clk, warmup=2, debounce=2)
+        fire_ticks = []
+        for i in range(1, 8):
+            budget.feed(2, shed=True)
+            tick = budget.evaluate()
+            if tick["fired"]:
+                fire_ticks.append(i)
+            clk.tick(0.5)
+        assert fire_ticks == [4]
+
+    def test_peek_never_ticks_the_machines(self):
+        """A scraper hammering ``peek`` (the /statsz path) must not
+        advance warmup/debounce — only ``evaluate`` is the detector
+        clock."""
+        clk = FakeClock()
+        budget = _budget(clk, warmup=2, debounce=2)
+        for _ in range(10):
+            budget.feed(2, shed=True)
+        for _ in range(50):
+            row = budget.peek()["p2:shed"]
+            assert row["breach"] is True  # visible immediately...
+            assert row["latched"] is False  # ...but never latched
+        # the machine still needs its full warmup + debounce of
+        # evaluate() ticks before firing
+        fires = 0
+        for _ in range(4):
+            budget.feed(2, shed=True)
+            fires += len(budget.evaluate()["fired"])
+            clk.tick(0.1)
+        assert fires == 1
+
+    def test_recovery_closes_episode_and_rearms(self):
+        """Calm fast window -> the latch clears, the episode closes
+        with t_end, and a second storm fires a second episode."""
+        clk = FakeClock()
+        budget = _budget(clk, fast_window_s=1.0, slow_window_s=3.0)
+        recovered = []
+
+        def storm(ticks):
+            out = []
+            for _ in range(ticks):
+                for _ in range(10):
+                    budget.feed(2, shed=True)
+                tick = budget.evaluate()
+                out += tick["fired"]
+                recovered.extend(tick["recovered"])
+                clk.tick(0.5)
+            return out
+
+        def calm(ticks):
+            for _ in range(ticks):
+                for _ in range(10):
+                    budget.feed(2, latency_ms=1.0)
+                tick = budget.evaluate()
+                recovered.extend(tick["recovered"])
+                clk.tick(0.5)
+
+        assert len(storm(6)) == 1
+        calm(10)  # fast window drains clean -> recovery
+        assert [r["detector"] for r in recovered] == ["p2:shed"]
+        assert len(storm(8)) == 1  # re-armed: fires again
+        snap = budget.snapshot()
+        episodes = [
+            e for e in snap["episodes"] if e["detector"] == "p2:shed"
+        ]
+        assert len(episodes) == 2
+        assert episodes[0]["t_end"] is not None
+        assert episodes[1]["t_end"] is None  # still open
+        assert snap["burn_rate_max"] > 1.0
+
+
+# ---------------------------------------------------------------------------
+# the fleet merge
+# ---------------------------------------------------------------------------
+
+
+def _host_block(offered, headroom, burn_fast, shed_ratio=0.0):
+    return {
+        "demand": {
+            "offered_rps": offered,
+            "demand_shed_ratio_max": shed_ratio,
+        },
+        "headroom": {
+            "headroom_rps": headroom, "capacity_rps_est": 100.0,
+        },
+        "slo_budget": {
+            "detectors": {
+                "p0:latency": {
+                    "burn_rate_fast": burn_fast,
+                    "burn_rate_slow": burn_fast,
+                },
+            },
+        },
+    }
+
+
+class TestFleetCapacityWindows:
+    def test_merge_sums_fresh_and_maxes_burn(self):
+        w = FleetCapacityWindows(stale_after=3)
+        w.record("h0", _host_block(50.0, 20.0, 0.5, 0.1))
+        w.record("h1", _host_block(30.0, -5.0, 4.0, 0.3))
+        snap = w.snapshot()
+        assert snap["hosts_fresh"] == 2 and snap["hosts_stale"] == 0
+        m = snap["merged"]
+        assert m["offered_rps"] == pytest.approx(80.0)
+        assert m["headroom_rps"] == pytest.approx(15.0)
+        assert m["burn_rate_max"] == pytest.approx(4.0)
+        assert m["demand_shed_ratio_max"] == pytest.approx(0.3)
+
+    def test_stale_host_excluded_from_merge(self):
+        """stale_after consecutive failures freeze a host out of the
+        merged view — its LAST numbers are never summed as live."""
+        w = FleetCapacityWindows(stale_after=2)
+        w.record("h0", _host_block(50.0, 20.0, 0.5))
+        w.record("h1", _host_block(500.0, 400.0, 9.0))
+        w.record_failure("h1")
+        assert w.snapshot()["merged"]["offered_rps"] == 550.0
+        w.record_failure("h1")  # streak hits stale_after
+        snap = w.snapshot()
+        assert snap["hosts_stale"] == 1
+        assert snap["hosts"]["h1"]["stale"] is True
+        m = snap["merged"]
+        assert m["offered_rps"] == pytest.approx(50.0)
+        assert m["burn_rate_max"] == pytest.approx(0.5)
+        # a good scrape resets the streak -> back in the merge
+        w.record("h1", _host_block(10.0, 5.0, 0.1))
+        assert w.snapshot()["merged"]["offered_rps"] == 60.0
+
+    def test_payload_without_block_is_a_failure(self):
+        """A pre-v8 host whose /statsz has no capacity block goes
+        capacity-stale — never a crash, never fabricated zeros."""
+        w = FleetCapacityWindows(stale_after=2)
+        w.record("h0", None)
+        w.record("h0", "not-a-dict")
+        snap = w.snapshot()
+        assert snap["hosts"]["h0"]["stale"] is True
+        assert snap["hosts"]["h0"]["failures"] == 2
+        assert snap["merged"]["offered_rps"] is None
+
+
+# ---------------------------------------------------------------------------
+# the live /statsz block + measured offered rate, over real sockets
+# ---------------------------------------------------------------------------
+
+
+class TestLiveStatszCapacity:
+    def test_statsz_capacity_block_and_measured_rate(
+        self, http_frontend
+    ):
+        from tests.test_http import _predict, _request
+
+        plane = CapacityPlane(
+            slo_p99_ms=1000.0, slo_shed_rate=0.05, priorities=3,
+        )
+        fe = http_frontend(capacity=plane)
+        for i in range(5):
+            status, _, _ = _predict(fe, priority=2, tenant="bulk")
+            assert status == 200
+            time.sleep(0.02)
+        status, _, stats = _request(fe, "GET", "/statsz")
+        assert status == 200
+        cap = stats["capacity"]
+        key = demand_key("default", "bulk", 2)
+        row = cap["demand"]["keys"][key]
+        assert row["offered"] == 5 and row["completed"] == 5
+        assert row["identity_delta"] == 0
+        assert cap["demand"]["identity_ok"] is True
+        # detectors visible (peek), nothing latched by scraping
+        det = cap["slo_budget"]["detectors"]
+        assert set(det) == {
+            f"p{p}:{o}" for p in range(3)
+            for o in ("latency", "shed")
+        }
+        assert all(not r["latched"] for r in det.values())
+        assert cap["slo_budget"]["objectives"] == {
+            "slo_p99_ms": 1000.0, "slo_shed_rate": 0.05,
+        }
+        assert "headroom" in cap and "utilization" in cap
+        # the measured offered rate: observed arrival stamps, not a
+        # config knob — the serve-mode verdict's rate_rps source
+        acc = fe.accounting()
+        assert acc["measured_rate_rps"] is not None
+        assert 0.5 < acc["measured_rate_rps"] < 2000.0
+
+    def test_measured_rate_none_until_two_arrivals(
+        self, http_frontend
+    ):
+        """Fewer than two observed arrivals -> None ("not measured"),
+        never a fabricated rate."""
+        from tests.test_http import _predict
+
+        fe = http_frontend()
+        assert fe.accounting()["measured_rate_rps"] is None
+        _predict(fe, priority=0)
+        assert fe.accounting()["measured_rate_rps"] is None
+
+    def test_rejects_and_sheds_land_in_ledger(self, http_frontend):
+        from tests.test_http import _predict
+
+        fe = http_frontend(quotas={"greedy": (0.000001, 1.0)})
+        # burn greedy's single token, then the next is over-quota
+        assert _predict(fe, priority=1, tenant="greedy")[0] == 200
+        assert _predict(fe, priority=1, tenant="greedy")[0] == 429
+        snap = fe.capacity.ledger.snapshot()
+        row = snap["keys"][demand_key("default", "greedy", 1)]
+        assert row["offered"] == 2
+        assert row["rejected"] == 1 and row["admitted"] == 1
+        assert row["identity_delta"] == 0
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e: flash crowd against a 2-replica pool
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityAcceptance:
+    def test_flash_crowd_burn_breach_headroom_and_compare_gate(
+        self, exported_artifact, tmp_path
+    ):
+        """The acceptance pin, over real sockets and the real AOT
+        engines: a flash crowd against a 2-replica pool fires the bulk
+        class's shed burn-rate detector during the burst while the
+        premium class's budget stays intact; the verdict's capacity
+        block carries per-tenant demand and a headroom estimate that
+        went negative during the burst; the episode renders in
+        watch/summarize; and compare clean-vs-doctored (inflated burn
+        rate, flat aggregate p99) exits 3 on serve_burn_rate_max.
+
+        Capacity is shaped with the canary-drill fault-injection hook
+        (a per-batch latency inflation): client and server share one
+        interpreter here, so client-side pressure alone can never
+        out-offer the real engines — the injected service time puts
+        true capacity genuinely below the offered rate while leaving
+        the premium class's demand comfortably inside it."""
+        from bdbnn_tpu.configs.config import ServeHttpConfig
+        from bdbnn_tpu.obs.events import read_events, serve_digest
+        from bdbnn_tpu.obs.summarize import summarize_run
+        from bdbnn_tpu.obs.watch import render_status
+        from bdbnn_tpu.serve.http import run_serve_http
+
+        art_dir, _ = exported_artifact
+        cfg = ServeHttpConfig(
+            artifact=art_dir,
+            log_path=str(tmp_path / "serve_http"),
+            buckets=(1, 4),
+            priorities=3,
+            queue_depth=16,
+            max_delay_ms=2.0,
+            scenario="flash_crowd",
+            rate=800.0,
+            requests=4000,
+            flash_factor=8.0,
+            concurrency=48,
+            seed=0,
+            default_quota="100000:100000",
+            stats_interval_s=0.1,
+            replicas=2,
+            slo_p99_ms=2000.0,    # generous: latency never breaches
+            slo_shed_rate=0.005,  # tight shed budget: the crowd torches it
+        )
+        res = run_serve_http(cfg, degrade={"latency_ms": 6.0})
+        v = res["verdict"]
+        assert v["serve_verdict"] == 8
+        # scenario mode keeps the SCHEDULED rate (the measured-rate
+        # fix applies to serve mode only)
+        assert v["rate_rps"] == 800.0
+        # the burst forced real shedding, but never on priority 0
+        assert v["requests_shed"] > 0
+        p0 = v["per_priority"]["0"]
+        assert p0["shed_queue_full"] == 0 and p0["shed_draining"] == 0
+
+        cap = v["capacity"]
+        assert cap is not None
+        # per-tenant demand visible in the verdict block
+        assert cap["demand"]["by_tenant"]
+        assert cap["demand"]["identity_ok"] is True
+        assert cap["demand"]["in_flight_decisions"] == 0
+        # the bulk class's shed detector fired: burn above threshold,
+        # an episode on exactly a low-priority shed detector
+        assert cap["burn_rate_max"] is not None
+        assert cap["burn_rate_max"] > 1.0
+        episodes = cap["slo_budget"]["episodes"]
+        assert episodes, "no burn episode recorded"
+        assert all(e["objective"] == "shed" for e in episodes)
+        assert all(e["priority"] > 0 for e in episodes), (
+            "premium budget burned"
+        )
+        # premium peaks under threshold: budget intact
+        peaks = cap["slo_budget"]["burn_rate_peaks"]
+        assert peaks.get("p0:shed", 0.0) <= 1.0
+        assert peaks.get("p0:latency", 0.0) <= 1.0
+
+        events = read_events(res["run_dir"])
+        digest = serve_digest(events)
+        breaches = digest["capacity_breaches"]
+        assert breaches and all(
+            b["priority"] > 0 and b["objective"] == "shed"
+            for b in breaches
+        )
+        # the headroom estimate went negative while the burst was on:
+        # negative ticks exist and every one coincides with elevated
+        # demand + active shedding (never in the calm phases)
+        trail = digest["capacity_stats_trail"]
+        headrooms = [
+            (e["offered_rps"], (e.get("headroom") or {}))
+            for e in trail
+        ]
+        negative = [
+            (off, hr) for off, hr in headrooms
+            if hr.get("headroom_rps") is not None
+            and hr["headroom_rps"] < 0
+        ]
+        assert negative, "headroom never went negative during burst"
+        measurable_offered = [
+            off for off, hr in headrooms
+            if hr.get("headroom_rps") is not None
+        ]
+        # the negative ticks coincide with elevated demand: at least
+        # one lands in the top half of the observed offered-rps range
+        assert max(off for off, _ in negative) >= (
+            0.5 * max(measurable_offered)
+        )
+
+        # watch + summarize render the episode
+        status = render_status(events, None)
+        assert "capacity: burn max" in status
+        assert "burn episode: p" in status
+        report, summary = summarize_run(res["run_dir"])
+        assert summary["serving"]["verdict"]["capacity"] is not None
+        assert summary["serving"]["capacity_breaches"] >= 1
+        assert "capacity:" in report and "burn episode" in report
+
+        # compare clean-vs-doctored: inflate the burn gate, keep the
+        # aggregate p99 flat — exit 3 names serve_burn_rate_max
+        clean = tmp_path / "clean_verdict.json"
+        doctored = tmp_path / "doctored_verdict.json"
+        clean.write_text(json.dumps(v))
+        bad = json.loads(json.dumps(v))
+        bad["capacity"]["burn_rate_max"] = round(
+            v["capacity"]["burn_rate_max"] * 3.0, 4
+        )
+        doctored.write_text(json.dumps(bad))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "bdbnn_tpu.cli", "compare",
+             str(clean), str(doctored), "--json"],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=REPO,
+        )
+        assert proc.returncode == 3, proc.stderr[-800:]
+        result = json.loads(proc.stdout)
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert rows["serve_burn_rate_max"]["verdict"] == "regression"
+        assert rows["serve_p99_ms"]["verdict"] == "ok"
+        # and the identical pair passes clean
+        proc = subprocess.run(
+            [sys.executable, "-m", "bdbnn_tpu.cli", "compare",
+             str(clean), str(clean)],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]

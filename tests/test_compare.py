@@ -353,3 +353,75 @@ class TestFleetAttributionVersionSkew:
         )
         assert rows["serve_fleet_p99_network_ms"]["verdict"] == "ok"
         assert result["verdict"] == "regression"
+
+
+class TestCapacityVersionSkew:
+    """The three v8 capacity gates must skip cleanly when either side
+    predates the capacity observatory (pinned per the satellite): a
+    v7 verdict carries no capacity block, so the metrics land None on
+    that side -> no row, never a phantom verdict or a crash."""
+
+    V7 = {
+        "serve_verdict": 7,
+        "p99_ms": 12.0, "throughput_rps": 90.0, "shed_rate": 0.0,
+        "provenance": {"recipe": {"arch": "resnet8_tiny",
+                                  "dataset": "cifar10"}},
+    }
+
+    @staticmethod
+    def _v8(burn, headroom, shed_ratio):
+        v = dict(TestCapacityVersionSkew.V7)
+        v["serve_verdict"] = 8
+        v["capacity"] = {
+            "demand": {"offered_rps": 100.0},
+            "slo_budget": {"episodes": []},
+            "burn_rate_max": burn,
+            "headroom_rps": headroom,
+            "demand_shed_ratio_max": shed_ratio,
+        }
+        return v
+
+    def test_v7_verdict_extracts_none_for_capacity_metrics(self):
+        from bdbnn_tpu.obs.compare import _serve_metrics
+
+        m = _serve_metrics(dict(self.V7))
+        assert m["serve_burn_rate_max"] is None
+        assert m["serve_headroom_rps"] is None
+        assert m["serve_demand_shed_ratio_max"] is None
+
+    def test_v7_vs_v8_skips_both_directions(self, tmp_path):
+        a = tmp_path / "v7.json"
+        b = tmp_path / "v8.json"
+        a.write_text(json.dumps(self.V7))
+        b.write_text(json.dumps(self._v8(0.4, 120.0, 0.01)))
+        for pair in ([str(a), str(b)], [str(b), str(a)]):
+            result = compare_runs(pair)
+            judged = {
+                m["metric"]
+                for m in result["comparisons"][0]["metrics"]
+            }
+            assert "serve_burn_rate_max" not in judged
+            assert "serve_headroom_rps" not in judged
+            assert "serve_demand_shed_ratio_max" not in judged
+            assert result["verdict"] == "pass"
+
+    def test_v8_both_sides_judges_capacity_gates(self, tmp_path):
+        a = tmp_path / "clean.json"
+        b = tmp_path / "burning.json"
+        a.write_text(json.dumps(self._v8(0.5, 120.0, 0.01)))
+        # candidate: budget burning 3x harder, less headroom, worse
+        # shed ratio — all three gates regress; headroom judges as
+        # "higher is better" so the shrink is the regression
+        b.write_text(json.dumps(self._v8(1.5, 40.0, 0.05)))
+        result = compare_runs([str(a), str(b)])
+        rows = {
+            m["metric"]: m
+            for m in result["comparisons"][0]["metrics"]
+        }
+        assert rows["serve_burn_rate_max"]["verdict"] == "regression"
+        assert rows["serve_headroom_rps"]["verdict"] == "regression"
+        assert rows["serve_demand_shed_ratio_max"]["verdict"] == (
+            "regression"
+        )
+        assert rows["serve_p99_ms"]["verdict"] == "ok"
+        assert result["verdict"] == "regression"

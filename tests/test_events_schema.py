@@ -67,7 +67,10 @@ class TestEmitCallSites:
         kind (the `check --events-into` emit in cli.py), and the
         recipe-search harness's ``search``/``trial`` kinds
         (bdbnn_tpu/search/harness.py), and the performance
-        observatory's ``perf`` kind (bdbnn_tpu/obs/roofline.py)."""
+        observatory's ``perf`` kind (bdbnn_tpu/obs/roofline.py), and
+        the capacity observatory's ``capacity`` kind (obs/capacity.py
+        heartbeats + burn-rate breach/recovery transitions emitted by
+        the serve-http stats pump)."""
         _findings, found = scan_events(REPO, SCANNED)
         assert {"run_start", "compile", "train_interval", "eval",
                 "memory", "profile", "run_end",
@@ -75,7 +78,7 @@ class TestEmitCallSites:
                 "alert", "health", "export", "serve",
                 "http", "admission", "replica", "swap", "fleet",
                 "rtrace", "canary", "shadow", "search", "trial",
-                "analysis", "perf"} <= found
+                "analysis", "perf", "capacity"} <= found
 
     def test_registry_matches_docs(self):
         """KNOWN_KINDS and the events.py module docstring stay in sync
@@ -161,6 +164,61 @@ class TestStrictRfc8259:
         layers = rec["verdict"]["perf_layers"]
         assert layers["conv1|b8|unpack"] == pytest.approx(0.25)
         assert layers["fc|b8|unpack"] is None
+
+    def test_capacity_payload_roundtrips(self, tmp_path):
+        """The capacity observatory's worst-case payload: a burn rate
+        that divided by a zero-measurement window (NaN), numpy demand
+        counters from a future call site, and the nested
+        per-model / per-tenant / per-host tables a fleet-merged
+        ``capacity`` stats event carries — all must stay strict
+        RFC 8259."""
+        ev = EventWriter(str(tmp_path))
+        ev.emit(
+            "capacity",
+            phase="stats",
+            offered_rps=np.float32(120.5),
+            in_flight=np.int64(3),
+            demand_shed_ratio_max=float("nan"),
+            headroom={
+                "capacity_rps_est": np.float64(200.0),
+                "headroom_rps": np.float32("-inf"),
+                "seconds_to_saturation": float("nan"),
+            },
+            detectors={
+                "p2:shed": {
+                    "burn_rate_fast": float("nan"),
+                    "burn_rate_slow": np.float32(4.2),
+                    "breach": np.bool_(True),
+                },
+            },
+            demand={
+                "by_model": {"default": np.int64(41)},
+                "by_tenant": {"bulk": np.float32(0.25)},
+            },
+            hosts={
+                "h0": {"burn_rate_max": float("inf"),
+                       "offered_rps": np.float64(60.25)},
+            },
+        )
+        ev.close()
+        with open(ev.path) as f:
+            rec = self._strict(f.read().strip())
+        assert rec["offered_rps"] == pytest.approx(120.5)
+        assert isinstance(rec["offered_rps"], float)
+        assert rec["in_flight"] == 3 and isinstance(rec["in_flight"], int)
+        assert rec["demand_shed_ratio_max"] is None  # NaN -> null
+        hr = rec["headroom"]
+        assert hr["capacity_rps_est"] == 200.0
+        assert hr["headroom_rps"] is None  # -inf -> null
+        assert hr["seconds_to_saturation"] is None
+        det = rec["detectors"]["p2:shed"]
+        assert det["burn_rate_fast"] is None
+        assert det["burn_rate_slow"] == pytest.approx(4.2)
+        assert det["breach"] is True
+        assert rec["demand"]["by_model"]["default"] == 41
+        assert rec["demand"]["by_tenant"]["bulk"] == pytest.approx(0.25)
+        assert rec["hosts"]["h0"]["burn_rate_max"] is None
+        assert rec["hosts"]["h0"]["offered_rps"] == pytest.approx(60.25)
 
     def test_every_known_kind_emits_strict(self, tmp_path):
         """One adversarial record per registered kind: whatever fields

@@ -643,7 +643,7 @@ class TestFleetVerdict:
         finally:
             a.stop()
             b.stop()
-        assert verdict["serve_verdict"] == 7
+        assert verdict["serve_verdict"] == 8
         assert verdict["mode"] == "fleet"
         flt = verdict["fleet"]
         assert flt["dropped"] == 0
@@ -1003,7 +1003,7 @@ class TestFleetEndToEnd:
         res = run_serve_fleet(cfg, on_arrival=on_arrival)
         v = res["verdict"]
         assert killed, "the kill hook never fired"
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
         # zero client-visible drops across the host death: every
         # request got SOME response — 200 or an explicit shed
         assert v["client"]["dropped"] == 0
@@ -1162,7 +1162,7 @@ class TestFleetTraceAcceptance:
             self._cfg(fleet, str(tmp_path / "clean"))
         )
         cv = clean["verdict"]
-        assert cv["serve_verdict"] == 7
+        assert cv["serve_verdict"] == 8
         assert cv["client"]["dropped"] == 0
         assert cv["requests_failed"] == 0
         cfa = cv["fleet_attribution"]
@@ -1209,7 +1209,7 @@ class TestFleetTraceAcceptance:
             fleet["procs"][0].send_signal(signal.SIGCONT)
         wv = wedged["verdict"]
         assert wedged_at, "the wedge hook never fired"
-        assert wv["serve_verdict"] == 7
+        assert wv["serve_verdict"] == 8
         # the wedged host never DROPS a client: every parked exchange
         # times out at the router and retry-hops to the peer
         assert wv["client"]["dropped"] == 0
@@ -1363,3 +1363,92 @@ class TestFleetSigkill:
             assert v["requests_failed"] == 0
         finally:
             _reap_hosts(procs, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# 8. the router's capacity plane: scrape merge + measured offered rate
+# ---------------------------------------------------------------------------
+
+
+class TestRouterCapacityPlane:
+    def test_scrape_merges_capacity_and_marks_pre_v8_host_stale(self):
+        """One backend serves a ``capacity`` block in /statsz, the
+        other (a pre-v8 host) serves none: the scrape folds the first
+        into the fleet merge and walks the second to capacity-stale —
+        its absence is a recorded scrape failure, never fabricated
+        zeros in the merged view."""
+        cap_block = {
+            "demand": {
+                "offered_rps": 40.0, "demand_shed_ratio_max": 0.2,
+            },
+            "headroom": {
+                "headroom_rps": 60.0, "capacity_rps_est": 100.0,
+            },
+            "slo_budget": {
+                "detectors": {
+                    "p2:shed": {
+                        "burn_rate_fast": 3.0, "burn_rate_slow": 2.0,
+                    },
+                },
+            },
+        }
+        a = StubBackend("cap-a")
+        orig_route = a._route
+
+        def route(method, path, headers, body):
+            out = orig_route(method, path, headers, body)
+            if path == "/statsz" and out != "die":
+                status, obj = out
+                return status, dict(obj, capacity=cap_block)
+            return out
+
+        a._route = route
+        b = StubBackend("plain-b")
+        router = _router_over([a, b], scrape_stale_after=2)
+        try:
+            for _ in range(3):
+                router.scrape_host_stats()
+            snap = router.stats()["capacity"]
+            h0, h1 = snap["hosts"]["h0"], snap["hosts"]["h1"]
+            assert h0["stale"] is False
+            assert h0["offered_rps"] == pytest.approx(40.0)
+            assert h0["burn_rate_max"] == pytest.approx(3.0)
+            assert h1["stale"] is True
+            assert h1["failures"] >= 2
+            assert snap["hosts_fresh"] == 1 and snap["hosts_stale"] == 1
+            merged = snap["merged"]
+            assert merged["offered_rps"] == pytest.approx(40.0)
+            assert merged["headroom_rps"] == pytest.approx(60.0)
+            assert merged["burn_rate_max"] == pytest.approx(3.0)
+            assert merged["demand_shed_ratio_max"] == pytest.approx(0.2)
+            # the fleet verdict block carries the three flat gates at
+            # the top level — the same contract as a host's block
+            block = router.capacity_block()
+            assert block["burn_rate_max"] == pytest.approx(3.0)
+            assert block["headroom_rps"] == pytest.approx(60.0)
+            assert block["demand_shed_ratio_max"] == pytest.approx(0.2)
+            assert block["fleet"]["hosts_stale"] == 1
+        finally:
+            router.drain(5.0)
+            a.stop()
+            b.stop()
+
+    def test_accounting_measures_offered_rate_from_arrivals(self):
+        """The router's verdict rate is MEASURED from arrival stamps:
+        None until two requests have been observed (never fabricated),
+        then the observed inter-arrival rate — what actually hit the
+        router, not a config knob."""
+        a = StubBackend("b0")
+        router = _router_over([a])
+        try:
+            assert router.accounting()["measured_rate_rps"] is None
+            _predict("127.0.0.1", router.port)
+            assert router.accounting()["measured_rate_rps"] is None
+            for _ in range(4):
+                _predict("127.0.0.1", router.port)
+                time.sleep(0.01)
+            rate = router.accounting()["measured_rate_rps"]
+            assert rate is not None and 0.5 < rate < 5000.0
+        finally:
+            router.drain(5.0)
+            a.stop()

@@ -479,7 +479,7 @@ class TestVerdictV2:
             scenario="flash_crowd", rate=100.0, seed=0,
             slo_p99_ms=10.0,
         )
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
         assert v["scenario"] == "flash_crowd"
         # aggregate identity
         assert v["requests_submitted"] == 10

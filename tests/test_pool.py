@@ -908,7 +908,7 @@ class TestVerdictV3Compare:
              "latencies_ms": [1.0]},
             {}, mode="open", rate=1.0, seed=0,
         )
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
         # v1/v2 consumers: the v3 blocks exist but are null
         assert v["replicas"] is None
         assert v["scaling"] is None and v["swap"] is None
@@ -1129,7 +1129,7 @@ class TestScalingSweep:
         )
         res = run_serve_bench(cfg)
         v = res["verdict"]
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
         scaling = v["scaling"]
         assert scaling["replicas"] == [1, 2, 4, 8]
         assert scaling["monotone"] is True, scaling
@@ -1348,7 +1348,7 @@ class TestSwapUnderFlashCrowdEndToEnd:
             r["version"] == "v0002"
             for r in v["replicas"]["per_replica"]
         )
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
 
     def test_events_watch_summarize_compare_consume_the_swap(
         self, swap_run, tmp_path

@@ -1011,7 +1011,7 @@ class TestConsumersRenderFleetAttribution:
         from bdbnn_tpu.obs.summarize import summarize_run
 
         run_dir, v = self._fleet_run_dir(tmp_path, wedged=False)
-        assert v["serve_verdict"] == 7
+        assert v["serve_verdict"] == 8
         text, summary = summarize_run(run_dir)
         fat = summary["serving"]["verdict"]["fleet_attribution"]
         assert fat["requests"] == 10

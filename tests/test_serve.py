@@ -390,7 +390,7 @@ class TestLoadGen:
         )
         assert parsed["shed_rate"] == 0.2
         assert parsed["requests_completed"] == 8
-        assert parsed["serve_verdict"] == 7
+        assert parsed["serve_verdict"] == 8
         # v1 consumers: the v2 blocks exist but are null on a plain
         # serve-bench verdict
         assert parsed["per_priority"] is None
